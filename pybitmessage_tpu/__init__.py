@@ -1,0 +1,27 @@
+"""pybitmessage_tpu — a TPU-native Bitmessage framework.
+
+A ground-up, Python-3 + JAX/Pallas re-design of the capabilities of
+PyBitmessage (reference: /root/reference):
+
+- ``utils``    — protocol primitives: varint, base58, addresses, hashes.
+- ``ops``      — JAX/Pallas TPU kernels (double-SHA512 proof-of-work search
+                 and batched verification).
+- ``parallel`` — device-mesh sharding of the nonce search space (pjit /
+                 shard_map over ICI) and early-exit collectives.
+- ``crypto``   — secp256k1 ECIES + ECDSA (via the ``cryptography`` library),
+                 WIF, deterministic key generation.
+- ``pow``      — the solver ladder: TPU → C++ (pthreads) → pure Python,
+                 mirroring the reference's GPU → C → multiprocessing ladder.
+- ``models``   — typed Bitmessage object payloads (msg / broadcast / pubkey /
+                 getpubkey) and their wire codecs.
+- ``storage``  — SQLite persistence (inbox / sent / pubkeys / inventory) with
+                 a single-writer discipline, plus the in-memory inventory cache.
+- ``network``  — asyncio P2P stack: framing, version handshake, inv/getdata/
+                 object gossip, dandelion, knownnodes, connection pool.
+- ``workers``  — send pipeline, object processor, address generator, cleaner.
+- ``api``      — JSON-RPC API speaking the reference's command vocabulary.
+- ``core``     — Node: explicit dependency-injected application object
+                 (replaces the reference's global singletons).
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,25 @@
+"""Typed Bitmessage wire-protocol models: constants, framing, objects, PoW math."""
+
+from .constants import (
+    MAGIC, OBJECT_GETPUBKEY, OBJECT_PUBKEY, OBJECT_MSG, OBJECT_BROADCAST,
+    OBJECT_ONIONPEER, NODE_NETWORK, NODE_SSL, NODE_DANDELION,
+    PROTOCOL_VERSION, MAX_OBJECT_PAYLOAD_SIZE, MAX_MESSAGE_SIZE,
+    MAX_INV_COUNT, MAX_ADDR_COUNT, MAX_TIME_OFFSET, MAX_TTL, MIN_TTL_SLACK,
+    DEFAULT_NONCE_TRIALS_PER_BYTE, DEFAULT_EXTRA_BYTES, RIDICULOUS_DIFFICULTY,
+)
+from .packet import Packet, pack_packet, unpack_header, HEADER_LEN, PacketError
+from .pow_math import pow_target, pow_value, check_pow, expected_trials
+from .objects import ObjectHeader, ObjectError
+
+__all__ = [
+    "MAGIC", "OBJECT_GETPUBKEY", "OBJECT_PUBKEY", "OBJECT_MSG",
+    "OBJECT_BROADCAST", "OBJECT_ONIONPEER", "NODE_NETWORK", "NODE_SSL",
+    "NODE_DANDELION", "PROTOCOL_VERSION", "MAX_OBJECT_PAYLOAD_SIZE",
+    "MAX_MESSAGE_SIZE", "MAX_INV_COUNT", "MAX_ADDR_COUNT", "MAX_TIME_OFFSET",
+    "MAX_TTL", "MIN_TTL_SLACK",
+    "DEFAULT_NONCE_TRIALS_PER_BYTE", "DEFAULT_EXTRA_BYTES",
+    "RIDICULOUS_DIFFICULTY",
+    "Packet", "pack_packet", "unpack_header", "HEADER_LEN", "PacketError",
+    "pow_target", "pow_value", "check_pow", "expected_trials",
+    "ObjectHeader", "ObjectError",
+]

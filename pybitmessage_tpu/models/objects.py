@@ -1,0 +1,113 @@
+"""Bitmessage object header codec.
+
+Every flooded object's payload is:
+
+    u64  nonce        (the PoW)
+    u64  expiresTime  (unix seconds)
+    u32  objectType   (0 getpubkey / 1 pubkey / 2 msg / 3 broadcast)
+    varint version
+    varint stream
+    ...  type-specific data
+
+Reference parse: src/network/bmobject.py (checks: PoW, expiry sanity,
+stream wanted, type-specific lengths) and src/network/bmproto.py:377-441.
+"""
+
+from __future__ import annotations
+
+import struct
+import time
+from dataclasses import dataclass
+
+from ..utils.hashes import inventory_hash
+from ..utils.varint import decode_varint, encode_varint
+from .constants import (
+    EXPIRES_GRACE,
+    MAX_OBJECT_PAYLOAD_SIZE,
+    MAX_TTL,
+    MIN_TTL_SLACK,
+    OBJECT_BROADCAST,
+    OBJECT_GETPUBKEY,
+    OBJECT_PUBKEY,
+)
+
+
+class ObjectError(ValueError):
+    def __init__(self, kind: str, detail: str = ""):
+        super().__init__(f"{kind}: {detail}" if detail else kind)
+        self.kind = kind
+
+
+@dataclass(frozen=True)
+class ObjectHeader:
+    nonce: int
+    expires: int
+    object_type: int
+    version: int
+    stream: int
+    header_length: int  # bytes consumed, i.e. offset of type-specific data
+
+    @classmethod
+    def parse(cls, data: bytes) -> "ObjectHeader":
+        if len(data) < 22:
+            raise ObjectError("tooshort", f"{len(data)} bytes")
+        if len(data) > MAX_OBJECT_PAYLOAD_SIZE:
+            raise ObjectError("toolarge", f"{len(data)} bytes")
+        nonce, expires, object_type = struct.unpack_from(">QQI", data)
+        version, nver = decode_varint(data, 20)
+        stream, nstream = decode_varint(data, 20 + nver)
+        return cls(nonce, expires, object_type, version, stream,
+                   20 + nver + nstream)
+
+    def check_expiry(self, now: float | None = None) -> None:
+        """Sanity bounds on expiresTime (reference: bmobject.py:46-49)."""
+        now = time.time() if now is None else now
+        if self.expires - now > MAX_TTL + 10800:
+            raise ObjectError("expiretoofar")
+        if now - self.expires > MIN_TTL_SLACK:
+            raise ObjectError("expired")
+
+    @property
+    def tag_offset(self) -> int:
+        return self.header_length
+
+
+def serialize_object(expires: int, object_type: int, version: int,
+                     stream: int, body: bytes, nonce: int = 0) -> bytes:
+    """Assemble a full object payload.  ``nonce=0`` leaves a placeholder
+    the PoW solver overwrites."""
+    return (struct.pack(">QQI", nonce, expires, object_type)
+            + encode_varint(version) + encode_varint(stream) + body)
+
+
+def object_payload_sans_nonce(object_bytes: bytes) -> bytes:
+    return object_bytes[8:]
+
+
+def embed_nonce(object_bytes: bytes, nonce: int) -> bytes:
+    return struct.pack(">Q", nonce) + object_bytes[8:]
+
+
+def object_inventory_hash(object_bytes: bytes) -> bytes:
+    return inventory_hash(object_bytes)
+
+
+def check_by_type(object_type: int, version: int, total_length: int) -> None:
+    """Per-type sanity checks on the FULL object payload length
+    (reference: bmobject.py:121-163).  Unknown types pass."""
+    if object_type == OBJECT_GETPUBKEY and total_length < 42:
+        raise ObjectError("invalidlength", "getpubkey too short")
+    elif object_type == OBJECT_PUBKEY and not 146 <= total_length <= 440:
+        raise ObjectError("invalidlength", "pubkey outside 146..440")
+    elif object_type == OBJECT_BROADCAST:
+        if total_length < 180:
+            raise ObjectError("invalidlength", "broadcast too short")
+        if version < 2:
+            raise ObjectError("invalidversion", "broadcast v<2 unsupported")
+
+
+__all__ = [
+    "ObjectHeader", "ObjectError", "serialize_object", "embed_nonce",
+    "object_payload_sans_nonce", "object_inventory_hash", "check_by_type",
+    "EXPIRES_GRACE",
+]

@@ -1,0 +1,20 @@
+"""Protocol primitives: varint, base58, hashes, address codec."""
+
+from .varint import encode_varint, decode_varint, VarintError
+from .base58 import b58encode_int, b58decode_int, b58encode, b58decode
+from .hashes import double_sha512, inventory_hash, ripemd160, sha512
+from .addresses import (
+    encode_address,
+    decode_address,
+    AddressError,
+    Address,
+    with_bm_prefix,
+)
+
+__all__ = [
+    "encode_varint", "decode_varint", "VarintError",
+    "b58encode_int", "b58decode_int", "b58encode", "b58decode",
+    "double_sha512", "inventory_hash", "ripemd160", "sha512",
+    "encode_address", "decode_address", "AddressError", "Address",
+    "with_bm_prefix",
+]

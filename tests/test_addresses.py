@@ -1,0 +1,131 @@
+"""Address / varint / base58 conformance tests.
+
+Modeled on the reference's test tier 1 (src/tests/test_addresses.py,
+test_packets.py) with the golden vectors from tests/golden.py.
+"""
+
+import pytest
+
+from pybitmessage_tpu.utils import (
+    Address, AddressError, b58decode, b58decode_int, b58encode,
+    b58encode_int, decode_address, decode_varint, encode_address,
+    encode_varint, VarintError, with_bm_prefix,
+)
+
+from .golden import SAMPLE_ADDRESS, SAMPLE_RIPE
+
+
+class TestVarint:
+    def test_boundaries(self):
+        assert encode_varint(0) == b"\x00"
+        assert encode_varint(252) == b"\xfc"
+        assert encode_varint(253) == b"\xfd\x00\xfd"
+        assert encode_varint(65535) == b"\xfd\xff\xff"
+        assert encode_varint(65536) == b"\xfe\x00\x01\x00\x00"
+        assert encode_varint(2**32 - 1) == b"\xfe\xff\xff\xff\xff"
+        assert encode_varint(2**32) == b"\xff\x00\x00\x00\x01\x00\x00\x00\x00"
+        assert encode_varint(2**64 - 1) == b"\xff" + b"\xff" * 8
+
+    def test_range_errors(self):
+        with pytest.raises(VarintError):
+            encode_varint(-1)
+        with pytest.raises(VarintError):
+            encode_varint(2**64)
+
+    @pytest.mark.parametrize("value", [
+        0, 1, 252, 253, 254, 65535, 65536, 123456789,
+        2**32 - 1, 2**32, 2**63, 2**64 - 1,
+    ])
+    def test_roundtrip(self, value):
+        encoded = encode_varint(value)
+        decoded, used = decode_varint(encoded)
+        assert decoded == value
+        assert used == len(encoded)
+
+    def test_minimal_encoding_enforced(self):
+        # 1 encoded with 3 bytes is malformed per protocol v3
+        with pytest.raises(VarintError):
+            decode_varint(b"\xfd\x00\x01")
+        with pytest.raises(VarintError):
+            decode_varint(b"\xfe\x00\x00\xff\xff")
+        with pytest.raises(VarintError):
+            decode_varint(b"\xff\x00\x00\x00\x00\xff\xff\xff\xff")
+
+    def test_truncated(self):
+        with pytest.raises(VarintError):
+            decode_varint(b"\xfd\x01")
+        assert decode_varint(b"") == (0, 0)
+
+    def test_offset(self):
+        data = b"\xab" + encode_varint(70000)
+        assert decode_varint(data, 1) == (70000, 5)
+
+
+class TestBase58:
+    def test_int_roundtrip(self):
+        for value in (0, 1, 57, 58, 255, 2**64, 10**40):
+            assert b58decode_int(b58encode_int(value)) == value
+
+    def test_known(self):
+        assert b58encode_int(0) == "1"
+        assert b58encode_int(58) == "21"
+
+    def test_invalid_chars(self):
+        assert b58decode_int("0OIl") == 0
+
+    def test_bytes_roundtrip(self):
+        for raw in (b"", b"\x00", b"\x00\x00hello", b"\xff\xfe", SAMPLE_RIPE):
+            assert b58decode(b58encode(raw)) == raw
+
+
+class TestAddresses:
+    def test_golden_encode(self):
+        assert encode_address(2, 1, SAMPLE_RIPE) == SAMPLE_ADDRESS
+
+    def test_golden_decode(self):
+        addr = decode_address(SAMPLE_ADDRESS)
+        assert addr.version == 2
+        assert addr.stream == 1
+        assert addr.ripe == SAMPLE_RIPE
+
+    @pytest.mark.parametrize("version", [2, 3, 4])
+    @pytest.mark.parametrize("prefix", [b"", b"\x00", b"\x00\x00"])
+    def test_roundtrip_leading_zeros(self, version, prefix):
+        ripe = (prefix + b"\x5a" * (20 - len(prefix)))
+        text = encode_address(version, 1, ripe)
+        addr = decode_address(text)
+        assert addr == Address(version, 1, ripe)
+
+    def test_checksum_failure(self):
+        bad = SAMPLE_ADDRESS[:-1] + ("2" if SAMPLE_ADDRESS[-1] != "2" else "3")
+        with pytest.raises(AddressError) as exc:
+            decode_address(bad)
+        assert exc.value.status in ("checksumfailed", "invalidcharacters")
+
+    def test_invalid_characters(self):
+        with pytest.raises(AddressError) as exc:
+            decode_address("BM-00000")
+        assert exc.value.status == "invalidcharacters"
+
+    def test_version_too_high(self):
+        from pybitmessage_tpu.utils.hashes import double_sha512
+        from pybitmessage_tpu.utils.varint import encode_varint as ev
+        payload = ev(5) + ev(1) + b"\x01" * 20
+        text = "BM-" + b58encode(payload + double_sha512(payload)[:4])
+        with pytest.raises(AddressError) as exc:
+            decode_address(text)
+        assert exc.value.status == "versiontoohigh"
+
+    def test_v4_malleability_rejected(self):
+        # v4 with an unstripped leading zero byte must be rejected
+        from pybitmessage_tpu.utils.hashes import double_sha512
+        from pybitmessage_tpu.utils.varint import encode_varint as ev
+        payload = ev(4) + ev(1) + b"\x00" + b"\x22" * 19
+        text = "BM-" + b58encode(payload + double_sha512(payload)[:4])
+        with pytest.raises(AddressError) as exc:
+            decode_address(text)
+        assert exc.value.status == "encodingproblem"
+
+    def test_bm_prefix(self):
+        assert with_bm_prefix(SAMPLE_ADDRESS[3:]) == SAMPLE_ADDRESS
+        assert with_bm_prefix("  " + SAMPLE_ADDRESS + " ") == SAMPLE_ADDRESS

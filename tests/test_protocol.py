@@ -1,0 +1,135 @@
+"""Packet framing, object header, and PoW-math conformance tests."""
+
+import hashlib
+import struct
+import time
+
+import pytest
+
+from pybitmessage_tpu.models import (
+    HEADER_LEN, MAGIC, ObjectError, ObjectHeader, Packet, PacketError,
+    check_pow, expected_trials, pack_packet, pow_target, pow_value,
+    unpack_header,
+)
+from pybitmessage_tpu.models.objects import (
+    check_by_type, embed_nonce, serialize_object,
+)
+from pybitmessage_tpu.models.packet import verify_payload
+from pybitmessage_tpu.utils.hashes import double_sha512, inventory_hash
+
+
+class TestPacket:
+    def test_header_layout(self):
+        pkt = pack_packet("version", b"abc")
+        assert len(pkt) == HEADER_LEN + 3
+        magic, cmd, length, checksum = struct.unpack("!L12sL4s", pkt[:24])
+        assert magic == MAGIC == 0xE9BEB4D9
+        assert cmd == b"version" + b"\x00" * 5
+        assert length == 3
+        assert checksum == hashlib.sha512(b"abc").digest()[:4]
+
+    def test_roundtrip(self):
+        pkt = pack_packet("inv", b"\x01" * 37)
+        cmd, length, checksum = unpack_header(pkt[:24])
+        assert cmd == "inv"
+        assert length == 37
+        assert verify_payload(pkt[24:], checksum)
+
+    def test_bad_magic(self):
+        with pytest.raises(PacketError):
+            unpack_header(b"\x00" * 24)
+
+    def test_oversize(self):
+        hdr = struct.pack("!L12sL4s", MAGIC, b"x", 2**24, b"\x00" * 4)
+        with pytest.raises(PacketError):
+            unpack_header(hdr)
+
+    def test_packet_dataclass(self):
+        assert Packet("ping", b"").to_bytes() == pack_packet("ping")
+
+
+class TestPowMath:
+    def test_target_formula(self):
+        # 1000-byte payload, 4-day TTL, default difficulty:
+        # floor semantics must match the reference's Py2 int division
+        length, ttl = 1000, 4 * 24 * 3600
+        weight = length + 1000
+        expected = 2**64 // (1000 * (weight + (ttl * weight) // 2**16))
+        assert pow_target(length, ttl) == expected
+
+    def test_target_clamps_difficulty_floor(self):
+        # demanded difficulty below network minimum is raised to it
+        assert pow_target(1000, 300, 1, 1) == pow_target(1000, 300)
+
+    def test_expected_trials_scale(self):
+        # mean trials = nTPB*(len+extra)*(1 + TTL/2^16): ~1.26e7 for 1 kB @ 4d
+        trials = expected_trials(1000 + 8, 4 * 24 * 3600)
+        assert trials == 12597000
+
+    def test_check_pow_roundtrip(self):
+        # construct a valid object by brute-forcing a tiny difficulty...
+        # instead use huge TTL=300 and verify via direct value comparison
+        body = b"\x00" * 50
+        expires = int(time.time()) + 3600
+        obj = serialize_object(expires, 2, 1, 1, body)
+        target = pow_target(len(obj), 3600)
+        initial = hashlib.sha512(obj[8:]).digest()
+        nonce = 0
+        while True:
+            trial = double_sha512(struct.pack(">Q", nonce) + initial)
+            if int.from_bytes(trial[:8], "big") <= target:
+                break
+            nonce += 1
+        solved = embed_nonce(obj, nonce)
+        assert pow_value(solved) <= target
+        assert check_pow(solved)
+
+    def test_check_pow_rejects_zero_nonce_usually(self):
+        body = b"\x01" * 50
+        expires = int(time.time()) + 3600 * 24
+        obj = serialize_object(expires, 2, 1, 1, body, nonce=0)
+        assert not check_pow(obj)
+
+
+class TestObjectHeader:
+    def test_parse_roundtrip(self):
+        expires = int(time.time()) + 1000
+        obj = serialize_object(expires, 2, 1, 5, b"payload", nonce=42)
+        hdr = ObjectHeader.parse(obj)
+        assert (hdr.nonce, hdr.expires, hdr.object_type) == (42, expires, 2)
+        assert (hdr.version, hdr.stream) == (1, 5)
+        assert obj[hdr.header_length:] == b"payload"
+
+    def test_expiry_bounds(self):
+        now = time.time()
+        ok = serialize_object(int(now) + 1000, 2, 1, 1, b"x")
+        ObjectHeader.parse(ok).check_expiry(now)
+        stale = serialize_object(int(now) - 4000, 2, 1, 1, b"x")
+        with pytest.raises(ObjectError):
+            ObjectHeader.parse(stale).check_expiry(now)
+        fartoofar = serialize_object(int(now) + 29 * 24 * 3600, 2, 1, 1, b"x")
+        with pytest.raises(ObjectError):
+            ObjectHeader.parse(fartoofar).check_expiry(now)
+
+    def test_too_short(self):
+        with pytest.raises(ObjectError):
+            ObjectHeader.parse(b"\x00" * 10)
+
+    def test_type_checks(self):
+        check_by_type(2, 1, 500)           # msg: no constraint
+        check_by_type(99, 1, 5)            # unknown: pass
+        with pytest.raises(ObjectError):
+            check_by_type(0, 1, 41)        # getpubkey < 42
+        with pytest.raises(ObjectError):
+            check_by_type(1, 1, 145)       # pubkey < 146
+        with pytest.raises(ObjectError):
+            check_by_type(1, 1, 441)       # pubkey > 440
+        with pytest.raises(ObjectError):
+            check_by_type(3, 1, 179)       # broadcast < 180
+        with pytest.raises(ObjectError):
+            check_by_type(3, 1, 500)       # broadcast v1 unsupported
+
+    def test_inventory_hash(self):
+        obj = serialize_object(1, 2, 1, 1, b"z", nonce=7)
+        assert inventory_hash(obj) == double_sha512(obj)[:32]
+        assert len(inventory_hash(obj)) == 32
