@@ -1,0 +1,28 @@
+"""TPU compute kernels (JAX / Pallas).
+
+The hot loop of the whole framework is the proof-of-work nonce search:
+``SHA512(SHA512(nonce || initialHash))`` with the first 8 bytes compared
+against a 64-bit target (reference: src/bitmsghash/bitmsghash.cpp:54-68,
+src/proofofwork.py:104-107).  TPU vector units have no native uint64, so
+all 64-bit words are modelled as (hi, lo) uint32 pairs and the search is
+vectorized over a wide lane axis feeding the VPU.
+
+- ``u64``          — (hi, lo) uint32-pair arithmetic.
+- ``sha512_jax``   — batched one-block SHA-512 compression + the 72-byte
+                     double-SHA512 PoW trial.
+- ``pow_search``   — single-device chunked nonce search with early exit,
+                     and batched PoW verification.
+- ``sha512_pallas``— Pallas kernel variant keeping the whole round state
+                     in VMEM.
+"""
+
+from .u64 import (  # noqa: F401
+    add64, and64, le64, not64, or64, rotr64, shr64, xor64,
+    u64_from_int, u64_to_int,
+)
+from .sha512_jax import (  # noqa: F401
+    sha512_block, double_sha512_trial, initial_hash_words, trial_values,
+)
+from .pow_search import (  # noqa: F401
+    pow_search_jit, pow_verify_batch, solve, verify,
+)

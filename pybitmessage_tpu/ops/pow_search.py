@@ -1,0 +1,153 @@
+"""Single-device PoW nonce search and batched verification (JAX).
+
+Search strategy (reference semantics: src/proofofwork.py:288-325, nonce
+strided over workers; src/openclpow.py:96-107, host loop over batches):
+a jitted ``lax.while_loop`` evaluates ``lanes`` double-SHA512 trials per
+iteration and exits as soon as any lane beats the target.  The host
+wrapper re-invokes the jitted search in slabs so a Python-level shutdown
+flag can interrupt arbitrarily long searches (reference aborts via
+``state.shutdown`` checks inside every solver, proofofwork.py:104-191).
+
+Verification of flooded incoming objects is a pure batch computation —
+one fused launch checks a whole batch of (nonce, initialHash, target)
+triples (reference verifies one at a time on the host,
+src/protocol.py:258-286; batching is the TPU-native win).
+"""
+
+from __future__ import annotations
+
+import functools
+import hashlib
+from typing import Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from .sha512_jax import double_sha512_trial, initial_hash_words, trial_values
+from .u64 import le64, u64_from_int, u64_to_int, U32
+
+#: lanes per while_loop iteration; multiple of 8*128 VPU tiles.
+DEFAULT_LANES = 1 << 15
+#: while_loop iterations per jitted call (between shutdown checks).
+DEFAULT_CHUNKS_PER_CALL = 64
+
+
+@functools.partial(jax.jit, static_argnames=("lanes", "max_chunks"))
+def pow_search_jit(ih_hi, ih_lo, target_hi, target_lo, start_hi, start_lo,
+                   lanes: int = DEFAULT_LANES,
+                   max_chunks: int = DEFAULT_CHUNKS_PER_CALL):
+    """Search nonces [start, start + lanes*max_chunks) for value <= target.
+
+    Returns (found: bool, nonce_hi, nonce_lo, chunks_done: int32).
+    Exits the loop at the first chunk containing a hit.
+    """
+    lanes_pair = u64_from_int(lanes)
+
+    def cond(carry):
+        found, chunk = carry[0], carry[1]
+        return jnp.logical_and(jnp.logical_not(found), chunk < max_chunks)
+
+    def body(carry):
+        found, chunk, base_hi, base_lo, nonce_hi, nonce_lo = carry
+        (v_hi, v_lo), (n_hi, n_lo) = trial_values(
+            base_hi, base_lo, ih_hi, ih_lo, lanes)
+        ok = le64((v_hi, v_lo), (target_hi, target_lo))
+        hit = jnp.any(ok)
+        idx = jnp.argmax(ok)  # first winning lane
+        nonce_hi = jnp.where(hit, n_hi[idx], nonce_hi)
+        nonce_lo = jnp.where(hit, n_lo[idx], nonce_lo)
+        lo = base_lo + lanes_pair[1]
+        hi = base_hi + lanes_pair[0] + (lo < base_lo).astype(U32)
+        return (jnp.logical_or(found, hit), chunk + 1, hi, lo,
+                nonce_hi, nonce_lo)
+
+    carry = (jnp.bool_(False), jnp.int32(0), start_hi, start_lo,
+             jnp.uint32(0), jnp.uint32(0))
+    found, chunks, _, _, nonce_hi, nonce_lo = jax.lax.while_loop(
+        cond, body, carry)
+    return found, nonce_hi, nonce_lo, chunks
+
+
+def solve(initial_hash: bytes, target: int, *,
+          start_nonce: int = 0,
+          lanes: int = DEFAULT_LANES,
+          chunks_per_call: int = DEFAULT_CHUNKS_PER_CALL,
+          should_stop: Callable[[], bool] | None = None):
+    """Find a nonce whose trial value is <= target.
+
+    Host driver over :func:`pow_search_jit`; between jitted slabs the
+    optional ``should_stop`` callback is polled (shutdown semantics of
+    reference proofofwork.py:104-191).  Returns (nonce, trials_done) or
+    raises :class:`StopIteration` when interrupted.
+
+    The winning nonce is re-verified host-side with hashlib before being
+    returned, guarding against accelerator miscompute the way the
+    reference re-checks OpenCL results (proofofwork.py:302-313).
+    """
+    ih_hi, ih_lo = initial_hash_words(initial_hash)
+    t_hi, t_lo = u64_from_int(target)
+    base = start_nonce
+    trials = 0
+    while True:
+        if should_stop is not None and should_stop():
+            raise StopIteration("PoW interrupted by shutdown")
+        b_hi, b_lo = u64_from_int(base)
+        found, n_hi, n_lo, chunks = pow_search_jit(
+            ih_hi, ih_lo, t_hi, t_lo, b_hi, b_lo, lanes, chunks_per_call)
+        chunks = int(chunks)
+        trials += chunks * lanes
+        if bool(found):
+            nonce = u64_to_int(n_hi, n_lo)
+            check = hashlib.sha512(hashlib.sha512(
+                nonce.to_bytes(8, "big") + initial_hash).digest()).digest()
+            if int.from_bytes(check[:8], "big") > target:  # pragma: no cover
+                raise ArithmeticError(
+                    "accelerator returned an invalid PoW nonce")
+            return nonce, trials
+        base += chunks * lanes
+
+
+@jax.jit
+def pow_verify_batch(nonce_hi, nonce_lo, ih_hi, ih_lo, target_hi, target_lo):
+    """Vector PoW check: (B,) nonces, (8, B) initial-hash words, (B,) targets.
+
+    Returns a (B,) bool array — True where the object's PoW is valid.
+    """
+    v = double_sha512_trial(nonce_hi, nonce_lo, ih_hi, ih_lo)
+    return le64(v, (target_hi, target_lo))
+
+
+def verify(items: Sequence[tuple[int, bytes, int]]) -> list[bool]:
+    """Batch-verify (nonce, initial_hash, target) triples on device.
+
+    Pads to the next power of two to bound recompilations.
+    """
+    if not items:
+        return []
+    n = len(items)
+    size = 1
+    while size < n:
+        size *= 2
+    nh, nl, th, tl = (jnp.zeros(size, dtype=U32) for _ in range(4))
+    ih_hi = jnp.zeros((8, size), dtype=U32)
+    ih_lo = jnp.zeros((8, size), dtype=U32)
+    nh_l, nl_l, th_l, tl_l = [], [], [], []
+    ih_hi_l, ih_lo_l = [], []
+    for nonce, ih, target in items:
+        nonce &= (1 << 64) - 1
+        nh_l.append(nonce >> 32)
+        nl_l.append(nonce & 0xFFFFFFFF)
+        th_l.append((target >> 32) & 0xFFFFFFFF)
+        tl_l.append(target & 0xFFFFFFFF)
+        words = [int.from_bytes(ih[i:i + 8], "big") for i in range(0, 64, 8)]
+        ih_hi_l.append([w >> 32 for w in words])
+        ih_lo_l.append([w & 0xFFFFFFFF for w in words])
+    pad = size - n
+    nh = jnp.array(nh_l + [0] * pad, dtype=U32)
+    nl = jnp.array(nl_l + [0] * pad, dtype=U32)
+    th = jnp.array(th_l + [0] * pad, dtype=U32)
+    tl = jnp.array(tl_l + [0] * pad, dtype=U32)
+    ih_hi = jnp.array(ih_hi_l + [[0] * 8] * pad, dtype=U32).T
+    ih_lo = jnp.array(ih_lo_l + [[0] * 8] * pad, dtype=U32).T
+    ok = pow_verify_batch(nh, nl, ih_hi, ih_lo, th, tl)
+    return [bool(b) for b in ok[:n]]

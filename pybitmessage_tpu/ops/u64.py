@@ -1,0 +1,85 @@
+"""uint64 arithmetic as (hi, lo) uint32 pairs.
+
+TPU VPUs operate on 32-bit lanes; there is no native 64-bit integer
+vector type.  SHA-512 is pure 64-bit word arithmetic, so every word is
+carried as two uint32 arrays.  All shift amounts used by SHA-512 are
+compile-time constants, so rotations specialize at trace time.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+U32 = jnp.uint32
+
+
+def u64_from_int(value: int):
+    """Split a Python int into (hi, lo) uint32 scalars."""
+    value &= (1 << 64) - 1
+    return jnp.uint32(value >> 32), jnp.uint32(value & 0xFFFFFFFF)
+
+
+def u64_to_int(hi, lo) -> int:
+    """Reassemble a Python int from (hi, lo) scalars (host-side)."""
+    return (int(hi) << 32) | int(lo)
+
+
+def add64(a, b):
+    """(hi, lo) + (hi, lo) with carry propagation."""
+    a_hi, a_lo = a
+    b_hi, b_lo = b
+    lo = a_lo + b_lo
+    carry = (lo < a_lo).astype(U32)
+    return a_hi + b_hi + carry, lo
+
+
+def add64_many(*terms):
+    """Sum of several u64 pairs (left fold of add64)."""
+    acc = terms[0]
+    for t in terms[1:]:
+        acc = add64(acc, t)
+    return acc
+
+
+def xor64(a, b):
+    return a[0] ^ b[0], a[1] ^ b[1]
+
+
+def and64(a, b):
+    return a[0] & b[0], a[1] & b[1]
+
+
+def or64(a, b):
+    return a[0] | b[0], a[1] | b[1]
+
+
+def not64(a):
+    return ~a[0], ~a[1]
+
+
+def rotr64(a, n: int):
+    """Rotate right by a static amount 1..63."""
+    hi, lo = a
+    if n == 32:
+        return lo, hi
+    if n < 32:
+        m = 32 - n
+        return (hi >> n) | (lo << m), (lo >> n) | (hi << m)
+    n -= 32
+    m = 32 - n
+    return (lo >> n) | (hi << m), (hi >> n) | (lo << m)
+
+
+def shr64(a, n: int):
+    """Logical shift right by a static amount 1..63."""
+    hi, lo = a
+    if n >= 32:
+        return jnp.zeros_like(hi), hi >> (n - 32)
+    return hi >> n, (lo >> n) | (hi << (32 - n))
+
+
+def le64(a, b):
+    """a <= b, elementwise over pairs."""
+    a_hi, a_lo = a
+    b_hi, b_lo = b
+    return (a_hi < b_hi) | ((a_hi == b_hi) & (a_lo <= b_lo))

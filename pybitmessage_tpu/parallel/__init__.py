@@ -1,0 +1,11 @@
+"""Device-mesh parallelism for the PoW search (pjit / shard_map over ICI).
+
+The reference has no multi-device story (one OpenCL GPU assumed,
+src/openclpow.py:26).  Here the nonce space is range-partitioned across
+every chip in the mesh and an all-reduced "found" flag gives pod-wide
+early exit — the TPU-native analog of the reference's per-thread nonce
+striding (src/bitmsghash/bitmsghash.cpp:76-125).
+"""
+
+from .mesh import make_mesh  # noqa: F401
+from .pow_sharded import make_sharded_search, sharded_solve  # noqa: F401

@@ -1,0 +1,119 @@
+"""Pod-wide PoW nonce search: shard_map over a device mesh.
+
+Partitioning: device *d* of *D* searches nonces
+``start + d*lanes + chunk*D*lanes + lane`` — contiguous per-chunk blocks
+interleaved across the mesh, the multi-chip generalization of the
+reference's per-thread striding (src/bitmsghash/bitmsghash.cpp:40-74).
+
+Early exit: each while_loop iteration all-reduces a "found" flag over
+the mesh axis (``psum`` rides ICI), so the whole pod stops within one
+chunk of the first hit.  The winning (device, nonce) is resolved with a
+tiny all_gather; every device returns the same replicated result.
+"""
+
+from __future__ import annotations
+
+import functools
+import hashlib
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+from jax import shard_map
+
+from ..ops.sha512_jax import initial_hash_words, trial_values
+from ..ops.u64 import add64, le64, u64_from_int, u64_to_int, U32
+
+
+def _device_search(ih_hi, ih_lo, t_hi, t_lo, s_hi, s_lo,
+                   *, lanes: int, max_chunks: int, axis: str):
+    """Per-device body run under shard_map. All inputs replicated."""
+    dev = jax.lax.axis_index(axis)
+    ndev = jax.lax.psum(jnp.int32(1), axis)
+
+    # local start = start + dev * lanes
+    off = (jnp.uint32(0), dev.astype(U32) * jnp.uint32(lanes))
+    base = add64((s_hi, s_lo), off)
+    # per-chunk stride = ndev * lanes (lanes is static, ndev tiny)
+    stride_lo = ndev.astype(U32) * jnp.uint32(lanes)
+    stride = (jnp.uint32(0), stride_lo)
+
+    def cond(carry):
+        return jnp.logical_and(jnp.logical_not(carry[0]), carry[1] < max_chunks)
+
+    def body(carry):
+        _, chunk, b_hi, b_lo, n_hi, n_lo, local = carry
+        (v_hi, v_lo), (c_hi, c_lo) = trial_values(b_hi, b_lo, ih_hi, ih_lo, lanes)
+        ok = le64((v_hi, v_lo), (t_hi, t_lo))
+        hit = jnp.any(ok)
+        idx = jnp.argmax(ok)
+        n_hi = jnp.where(hit & ~local, c_hi[idx], n_hi)
+        n_lo = jnp.where(hit & ~local, c_lo[idx], n_lo)
+        local = jnp.logical_or(local, hit)
+        # pod-wide OR over ICI — the early-exit collective
+        global_found = jax.lax.psum(local.astype(jnp.int32), axis) > 0
+        b_hi, b_lo = add64((b_hi, b_lo), stride)
+        return (global_found, chunk + 1, b_hi, b_lo, n_hi, n_lo, local)
+
+    carry = (jnp.bool_(False), jnp.int32(0), base[0], base[1],
+             jnp.uint32(0), jnp.uint32(0), jnp.bool_(False))
+    _, chunks, _, _, n_hi, n_lo, local = jax.lax.while_loop(cond, body, carry)
+
+    # Resolve the pod-wide winner: gather every device's (found, nonce).
+    founds = jax.lax.all_gather(local, axis)          # (D,)
+    nonces_hi = jax.lax.all_gather(n_hi, axis)
+    nonces_lo = jax.lax.all_gather(n_lo, axis)
+    any_found = jnp.any(founds)
+    win = jnp.argmax(founds)
+    return (any_found, nonces_hi[win], nonces_lo[win], chunks)
+
+
+def make_sharded_search(mesh: Mesh, *, lanes: int = 1 << 13,
+                        max_chunks: int = 64, axis: str | None = None):
+    """Build a jitted pod-wide search fn over ``mesh``.
+
+    Returns ``fn(ih_hi, ih_lo, t_hi, t_lo, s_hi, s_lo) ->
+    (found, nonce_hi, nonce_lo, chunks)`` with all inputs/outputs
+    replicated; internally the nonce range is partitioned across the
+    mesh axis.
+    """
+    if axis is None:
+        axis = mesh.axis_names[-1]
+    body = functools.partial(_device_search, lanes=lanes,
+                             max_chunks=max_chunks, axis=axis)
+    reps = P()  # replicated in and out; partitioning is by axis_index
+    fn = shard_map(body, mesh=mesh,
+                   in_specs=(reps,) * 6, out_specs=(reps,) * 4,
+                   check_vma=False)
+    return jax.jit(fn)
+
+
+def sharded_solve(initial_hash: bytes, target: int, mesh: Mesh, *,
+                  start_nonce: int = 0, lanes: int = 1 << 13,
+                  chunks_per_call: int = 64,
+                  should_stop: Callable[[], bool] | None = None,
+                  _search_fn=None):
+    """Host driver for the pod-wide search (mirrors ops.pow_search.solve)."""
+    ndev = mesh.devices.size
+    fn = _search_fn or make_sharded_search(
+        mesh, lanes=lanes, max_chunks=chunks_per_call)
+    ih_hi, ih_lo = initial_hash_words(initial_hash)
+    t_hi, t_lo = u64_from_int(target)
+    base = start_nonce
+    trials = 0
+    while True:
+        if should_stop is not None and should_stop():
+            raise StopIteration("PoW interrupted by shutdown")
+        b_hi, b_lo = u64_from_int(base)
+        found, n_hi, n_lo, chunks = fn(ih_hi, ih_lo, t_hi, t_lo, b_hi, b_lo)
+        chunks = int(chunks)
+        trials += chunks * lanes * ndev
+        if bool(found):
+            nonce = u64_to_int(n_hi, n_lo)
+            check = hashlib.sha512(hashlib.sha512(
+                nonce.to_bytes(8, "big") + initial_hash).digest()).digest()
+            if int.from_bytes(check[:8], "big") > target:  # pragma: no cover
+                raise ArithmeticError("invalid nonce from sharded search")
+            return nonce, trials
+        base += chunks * lanes * ndev

@@ -1,0 +1,101 @@
+"""Golden tests for the JAX double-SHA512 PoW kernel against hashlib.
+
+Strategy mirrors the reference's PoW self-test (initial-hash → known
+nonce check, src/proofofwork.py:354-361) but checks the full pipeline
+against the host hashlib implementation on many random inputs.
+"""
+
+import hashlib
+import os
+
+import jax.numpy as jnp
+import pytest
+
+from pybitmessage_tpu.models.pow_math import pow_target, pow_value
+from pybitmessage_tpu.ops import (
+    pow_verify_batch, solve, verify,
+)
+from pybitmessage_tpu.ops.sha512_jax import (
+    double_sha512_trial, initial_hash_words, sha512_block,
+)
+from pybitmessage_tpu.ops.u64 import u64_from_int, u64_to_int, U32
+
+
+def _host_trial(nonce: int, initial_hash: bytes) -> int:
+    d = hashlib.sha512(hashlib.sha512(
+        nonce.to_bytes(8, "big") + initial_hash).digest()).digest()
+    return int.from_bytes(d[:8], "big")
+
+
+def test_sha512_single_block_against_hashlib():
+    # 72-byte message = one padded block, same layout the trial uses.
+    msg = bytes(range(72))
+    words = [int.from_bytes(msg[i:i + 8], "big") for i in range(0, 72, 8)]
+    w = words + [0x8000000000000000] + [0] * 5 + [576]
+    w_hi = jnp.array([x >> 32 for x in w], dtype=U32)
+    w_lo = jnp.array([x & 0xFFFFFFFF for x in w], dtype=U32)
+    out_hi, out_lo = sha512_block(w_hi, w_lo)
+    got = b"".join(
+        u64_to_int(out_hi[i], out_lo[i]).to_bytes(8, "big") for i in range(8))
+    assert got == hashlib.sha512(msg).digest()
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_double_sha512_trial_matches_host(seed):
+    rng = os.urandom if seed == 0 else None
+    initial_hash = hashlib.sha512(bytes([seed]) * 10).digest()
+    ih_hi, ih_lo = initial_hash_words(initial_hash)
+    nonces = [0, 1, 2, 255, 2**32 - 1, 2**32, 2**40 + 12345, 2**63 + 7]
+    n_hi = jnp.array([n >> 32 for n in nonces], dtype=U32)
+    n_lo = jnp.array([n & 0xFFFFFFFF for n in nonces], dtype=U32)
+    v_hi, v_lo = double_sha512_trial(n_hi, n_lo, ih_hi, ih_lo)
+    for i, nonce in enumerate(nonces):
+        assert u64_to_int(v_hi[i], v_lo[i]) == _host_trial(nonce, initial_hash)
+
+
+def test_solve_finds_valid_nonce_easy_target():
+    initial_hash = hashlib.sha512(b"pybitmessage-tpu solve test").digest()
+    target = 2**60  # ~1 in 16 trials
+    nonce, trials = solve(initial_hash, target, lanes=256, chunks_per_call=4)
+    assert _host_trial(nonce, initial_hash) <= target
+    assert trials >= 256
+
+
+def test_solve_interruptible():
+    initial_hash = hashlib.sha512(b"interrupt").digest()
+    calls = []
+
+    def stop():
+        calls.append(1)
+        return len(calls) > 1
+
+    with pytest.raises(StopIteration):
+        # Impossible target: only value 0 passes.
+        solve(initial_hash, 0, lanes=256, chunks_per_call=1,
+              should_stop=stop)
+
+
+def test_verify_batch_against_pow_value():
+    # Build full objects and verify through both host math and the kernel.
+    items = []
+    expected = []
+    for i in range(5):
+        payload = b"\x00" * 8 + bytes([i]) * 40  # nonce placeholder + body
+        initial_hash = hashlib.sha512(payload[8:]).digest()
+        target = pow_target(len(payload), 300)
+        nonce = i * 977 + 3
+        value = _host_trial(nonce, initial_hash)
+        items.append((nonce, initial_hash, target))
+        expected.append(value <= target)
+        # cross-check host-side helper agrees
+        obj = nonce.to_bytes(8, "big") + payload[8:]
+        assert pow_value(obj) == value
+    assert verify(items) == expected
+
+
+def test_verify_accepts_solved_nonce():
+    initial_hash = hashlib.sha512(b"round trip").digest()
+    target = 2**59
+    nonce, _ = solve(initial_hash, target, lanes=512, chunks_per_call=8)
+    assert verify([(nonce, initial_hash, target)]) == [True]
+    assert verify([(nonce + 1, initial_hash, 1)]) == [False]
