@@ -1,0 +1,59 @@
+"""PoW benchmark: double-SHA512 trial-hashes/sec on one chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+``vs_baseline`` compares the device hash rate against an in-process
+single-core hashlib nonce loop — the same work the reference's
+``_doSafePoW`` does per trial (reference: src/proofofwork.py:157-171).
+"""
+
+import hashlib
+import json
+import sys
+import time
+
+
+def _host_rate(initial_hash: bytes, trials: int = 20000) -> float:
+    """Single-core hashlib double-SHA512 trial rate (the safe-PoW analog)."""
+    t0 = time.perf_counter()
+    for nonce in range(trials):
+        hashlib.sha512(hashlib.sha512(
+            nonce.to_bytes(8, "big") + initial_hash).digest()).digest()
+    return trials / (time.perf_counter() - t0)
+
+
+def _device_rate(initial_hash: bytes) -> float:
+    import jax
+    from pybitmessage_tpu.ops.pow_search import pow_search_jit
+    from pybitmessage_tpu.ops.sha512_jax import initial_hash_words
+    from pybitmessage_tpu.ops.u64 import u64_from_int
+
+    ih_hi, ih_lo = initial_hash_words(initial_hash)
+    t_hi, t_lo = u64_from_int(1)      # unreachable target: full chunks
+    s_hi, s_lo = u64_from_int(0)
+    lanes, chunks = 1 << 19, 8
+
+    args = (ih_hi, ih_lo, t_hi, t_lo, s_hi, s_lo, lanes, chunks)
+    jax.block_until_ready(pow_search_jit(*args))       # compile + warm
+    best = 0.0
+    for _ in range(3):
+        t0 = time.perf_counter()
+        jax.block_until_ready(pow_search_jit(*args))
+        dt = time.perf_counter() - t0
+        best = max(best, lanes * chunks / dt)
+    return best
+
+
+def main():
+    initial_hash = hashlib.sha512(b"pybitmessage-tpu bench").digest()
+    device = _device_rate(initial_hash)
+    host = _host_rate(initial_hash)
+    print(json.dumps({
+        "metric": "double_sha512_trial_hashes_per_sec_per_chip",
+        "value": round(device, 1),
+        "unit": "H/s",
+        "vs_baseline": round(device / host, 2),
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -12,8 +12,6 @@ vectorized over a wide lane axis feeding the VPU.
                      double-SHA512 PoW trial.
 - ``pow_search``   — single-device chunked nonce search with early exit,
                      and batched PoW verification.
-- ``sha512_pallas``— Pallas kernel variant keeping the whole round state
-                     in VMEM.
 """
 
 from .u64 import (  # noqa: F401
@@ -24,5 +22,5 @@ from .sha512_jax import (  # noqa: F401
     sha512_block, double_sha512_trial, initial_hash_words, trial_values,
 )
 from .pow_search import (  # noqa: F401
-    pow_search_jit, pow_verify_batch, solve, verify,
+    PowInterrupted, pow_search_jit, pow_verify_batch, solve, verify,
 )

@@ -17,19 +17,60 @@ src/protocol.py:258-286; batching is the TPU-native win).
 from __future__ import annotations
 
 import functools
-import hashlib
 from typing import Callable, Sequence
 
 import jax
 import jax.numpy as jnp
 
+from ..utils.hashes import double_sha512
 from .sha512_jax import double_sha512_trial, initial_hash_words, trial_values
 from .u64 import le64, u64_from_int, u64_to_int, U32
 
-#: lanes per while_loop iteration; multiple of 8*128 VPU tiles.
-DEFAULT_LANES = 1 << 15
+#: lanes per while_loop iteration; multiple of 8*128 VPU tiles.  2^17 is
+#: the measured single-chip sweet spot (see BENCH notes in BASELINE.md).
+DEFAULT_LANES = 1 << 17
 #: while_loop iterations per jitted call (between shutdown checks).
-DEFAULT_CHUNKS_PER_CALL = 64
+DEFAULT_CHUNKS_PER_CALL = 32
+
+
+class PowInterrupted(Exception):
+    """Nonce search aborted by the shutdown callback.
+
+    A dedicated type (not StopIteration, which the iterator protocol
+    swallows) carrying no result; the pending object stays queued and
+    is retried on restart — checkpoint/resume semantics of the
+    reference's sent-state machine (class_singleWorker.py:720-724).
+    """
+
+
+def _run_host_driver(search_once, initial_hash: bytes, target: int, *,
+                     start_nonce: int, trials_per_call_step: int,
+                     should_stop: Callable[[], bool] | None):
+    """Shared host loop over a jitted search slab.
+
+    ``search_once(b_hi, b_lo) -> (found, n_hi, n_lo, chunks)``;
+    ``trials_per_call_step`` = trials represented by one chunk across
+    all participating devices.  Re-verifies the winning nonce with
+    hashlib before returning, guarding against accelerator miscompute
+    (the reference re-checks OpenCL results, proofofwork.py:302-313).
+    """
+    base = start_nonce
+    trials = 0
+    while True:
+        if should_stop is not None and should_stop():
+            raise PowInterrupted("PoW interrupted by shutdown")
+        b_hi, b_lo = u64_from_int(base)
+        found, n_hi, n_lo, chunks = search_once(b_hi, b_lo)
+        chunks = int(chunks)
+        trials += chunks * trials_per_call_step
+        if bool(found):
+            nonce = u64_to_int(n_hi, n_lo)
+            check = double_sha512(nonce.to_bytes(8, "big") + initial_hash)
+            if int.from_bytes(check[:8], "big") > target:  # pragma: no cover
+                raise ArithmeticError(
+                    "accelerator returned an invalid PoW nonce")
+            return nonce, trials
+        base += chunks * trials_per_call_step
 
 
 @functools.partial(jax.jit, static_argnames=("lanes", "max_chunks"))
@@ -78,33 +119,18 @@ def solve(initial_hash: bytes, target: int, *,
     Host driver over :func:`pow_search_jit`; between jitted slabs the
     optional ``should_stop`` callback is polled (shutdown semantics of
     reference proofofwork.py:104-191).  Returns (nonce, trials_done) or
-    raises :class:`StopIteration` when interrupted.
-
-    The winning nonce is re-verified host-side with hashlib before being
-    returned, guarding against accelerator miscompute the way the
-    reference re-checks OpenCL results (proofofwork.py:302-313).
+    raises :class:`PowInterrupted` when interrupted.
     """
     ih_hi, ih_lo = initial_hash_words(initial_hash)
     t_hi, t_lo = u64_from_int(target)
-    base = start_nonce
-    trials = 0
-    while True:
-        if should_stop is not None and should_stop():
-            raise StopIteration("PoW interrupted by shutdown")
-        b_hi, b_lo = u64_from_int(base)
-        found, n_hi, n_lo, chunks = pow_search_jit(
-            ih_hi, ih_lo, t_hi, t_lo, b_hi, b_lo, lanes, chunks_per_call)
-        chunks = int(chunks)
-        trials += chunks * lanes
-        if bool(found):
-            nonce = u64_to_int(n_hi, n_lo)
-            check = hashlib.sha512(hashlib.sha512(
-                nonce.to_bytes(8, "big") + initial_hash).digest()).digest()
-            if int.from_bytes(check[:8], "big") > target:  # pragma: no cover
-                raise ArithmeticError(
-                    "accelerator returned an invalid PoW nonce")
-            return nonce, trials
-        base += chunks * lanes
+
+    def search_once(b_hi, b_lo):
+        return pow_search_jit(ih_hi, ih_lo, t_hi, t_lo, b_hi, b_lo,
+                              lanes, chunks_per_call)
+
+    return _run_host_driver(
+        search_once, initial_hash, target, start_nonce=start_nonce,
+        trials_per_call_step=lanes, should_stop=should_stop)
 
 
 @jax.jit
@@ -128,9 +154,6 @@ def verify(items: Sequence[tuple[int, bytes, int]]) -> list[bool]:
     size = 1
     while size < n:
         size *= 2
-    nh, nl, th, tl = (jnp.zeros(size, dtype=U32) for _ in range(4))
-    ih_hi = jnp.zeros((8, size), dtype=U32)
-    ih_lo = jnp.zeros((8, size), dtype=U32)
     nh_l, nl_l, th_l, tl_l = [], [], [], []
     ih_hi_l, ih_lo_l = [], []
     for nonce, ih, target in items:

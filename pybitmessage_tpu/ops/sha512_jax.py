@@ -92,28 +92,34 @@ def sha512_block(w_hi, w_lo):
     are generated in place in the rolling window.
     """
 
-    def round_body(t, carry):
-        a, b, c, d, e, f, g, h, wh, wl = carry
-        i = t % 16
-        wt = (wh[i], wl[i])
-        kt = (K_HI[t], K_LO[t])
+    def round_body(extend_schedule):
+        def body(t, carry):
+            a, b, c, d, e, f, g, h, wh, wl = carry
+            i = t % 16
+            wt = (wh[i], wl[i])
+            kt = (K_HI[t], K_LO[t])
 
-        ch = ((e[0] & f[0]) ^ (~e[0] & g[0]), (e[1] & f[1]) ^ (~e[1] & g[1]))
-        maj = (
-            (a[0] & b[0]) ^ (a[0] & c[0]) ^ (b[0] & c[0]),
-            (a[1] & b[1]) ^ (a[1] & c[1]) ^ (b[1] & c[1]),
-        )
-        t1 = add64_many(h, _big_sigma1(e), ch, kt, wt)
-        t2 = add64(_big_sigma0(a), maj)
+            ch = ((e[0] & f[0]) ^ (~e[0] & g[0]),
+                  (e[1] & f[1]) ^ (~e[1] & g[1]))
+            maj = (
+                (a[0] & b[0]) ^ (a[0] & c[0]) ^ (b[0] & c[0]),
+                (a[1] & b[1]) ^ (a[1] & c[1]) ^ (b[1] & c[1]),
+            )
+            t1 = add64_many(h, _big_sigma1(e), ch, kt, wt)
+            t2 = add64(_big_sigma0(a), maj)
 
-        # Prepare schedule word t+16 in place.
-        s0 = _small_sigma0((wh[(t + 1) % 16], wl[(t + 1) % 16]))
-        s1 = _small_sigma1((wh[(t + 14) % 16], wl[(t + 14) % 16]))
-        w_new = add64_many(wt, s0, (wh[(t + 9) % 16], wl[(t + 9) % 16]), s1)
-        wh = wh.at[i].set(w_new[0])
-        wl = wl.at[i].set(w_new[1])
+            if extend_schedule:
+                # Prepare schedule word t+16 in place.
+                s0 = _small_sigma0((wh[(t + 1) % 16], wl[(t + 1) % 16]))
+                s1 = _small_sigma1((wh[(t + 14) % 16], wl[(t + 14) % 16]))
+                w_new = add64_many(
+                    wt, s0, (wh[(t + 9) % 16], wl[(t + 9) % 16]), s1)
+                wh = wh.at[i].set(w_new[0])
+                wl = wl.at[i].set(w_new[1])
 
-        return (add64(t1, t2), a, b, c, add64(d, t1), e, f, g, wh, wl)
+            return (add64(t1, t2), a, b, c, add64(d, t1), e, f, g, wh, wl)
+
+        return body
 
     state = tuple((H0_HI[i], H0_LO[i]) for i in range(8))
     # Broadcast initial state to the batch shape of the message words.
@@ -125,7 +131,11 @@ def sha512_block(w_hi, w_lo):
         )
 
     carry = (*state, w_hi, w_lo)
-    carry = jax.lax.fori_loop(0, 80, round_body, carry)
+    # Rounds 64-79 read only already-extended schedule words W[64..79],
+    # so the in-place extension (which would compute W[80..95]) is waste
+    # there — ~20% of schedule work in the hottest loop.
+    carry = jax.lax.fori_loop(0, 64, round_body(True), carry)
+    carry = jax.lax.fori_loop(64, 80, round_body(False), carry)
     final = carry[:8]
 
     out = tuple(add64((H0_HI[i], H0_LO[i]), final[i]) for i in range(8))

@@ -8,4 +8,6 @@ striding (src/bitmsghash/bitmsghash.cpp:76-125).
 """
 
 from .mesh import make_mesh  # noqa: F401
-from .pow_sharded import make_sharded_search, sharded_solve  # noqa: F401
+from .pow_sharded import (  # noqa: F401
+    make_sharded_batch_search, make_sharded_search, sharded_solve,
+)

@@ -14,7 +14,6 @@ tiny all_gather; every device returns the same replicated result.
 from __future__ import annotations
 
 import functools
-import hashlib
 from typing import Callable
 
 import jax
@@ -22,8 +21,9 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 from jax import shard_map
 
+from ..ops.pow_search import _run_host_driver
 from ..ops.sha512_jax import initial_hash_words, trial_values
-from ..ops.u64 import add64, le64, u64_from_int, u64_to_int, U32
+from ..ops.u64 import add64, le64, u64_from_int, U32
 
 
 def _device_search(ih_hi, ih_lo, t_hi, t_lo, s_hi, s_lo,
@@ -89,31 +89,50 @@ def make_sharded_search(mesh: Mesh, *, lanes: int = 1 << 13,
     return jax.jit(fn)
 
 
+def make_sharded_batch_search(mesh: Mesh, *, lanes: int = 1 << 13,
+                              max_chunks: int = 64,
+                              obj_axis: str = "obj",
+                              nonce_axis: str = "nonce"):
+    """Pod-wide search over a BATCH of pending objects on a 2D mesh.
+
+    Objects are data-parallel over ``obj_axis`` while each object's
+    nonce range is partitioned over ``nonce_axis`` — the "batch all
+    pending workerQueue objects into one grid" design.  Inputs:
+    ``ih_hi, ih_lo``: (B, 8) initial-hash words; ``t_hi, t_lo, s_hi,
+    s_lo``: (B,).  Outputs (found, nonce_hi, nonce_lo, chunks): (B,).
+    The vmapped while_loop runs until every local object has a hit (or
+    max_chunks), so per-object early exit is batch-granular.
+    """
+    def local(ih_hi, ih_lo, t_hi, t_lo, s_hi, s_lo):
+        search_one = functools.partial(
+            _device_search, lanes=lanes, max_chunks=max_chunks,
+            axis=nonce_axis)
+        return jax.vmap(search_one)(ih_hi, ih_lo, t_hi, t_lo, s_hi, s_lo)
+
+    obj = P(obj_axis)
+    fn = shard_map(
+        local, mesh=mesh,
+        in_specs=(P(obj_axis, None), P(obj_axis, None), obj, obj, obj, obj),
+        out_specs=(obj,) * 4,
+        check_vma=False)
+    return jax.jit(fn)
+
+
 def sharded_solve(initial_hash: bytes, target: int, mesh: Mesh, *,
                   start_nonce: int = 0, lanes: int = 1 << 13,
                   chunks_per_call: int = 64,
                   should_stop: Callable[[], bool] | None = None,
                   _search_fn=None):
-    """Host driver for the pod-wide search (mirrors ops.pow_search.solve)."""
+    """Host driver for the pod-wide search (same contract as ops.solve)."""
     ndev = mesh.devices.size
     fn = _search_fn or make_sharded_search(
         mesh, lanes=lanes, max_chunks=chunks_per_call)
     ih_hi, ih_lo = initial_hash_words(initial_hash)
     t_hi, t_lo = u64_from_int(target)
-    base = start_nonce
-    trials = 0
-    while True:
-        if should_stop is not None and should_stop():
-            raise StopIteration("PoW interrupted by shutdown")
-        b_hi, b_lo = u64_from_int(base)
-        found, n_hi, n_lo, chunks = fn(ih_hi, ih_lo, t_hi, t_lo, b_hi, b_lo)
-        chunks = int(chunks)
-        trials += chunks * lanes * ndev
-        if bool(found):
-            nonce = u64_to_int(n_hi, n_lo)
-            check = hashlib.sha512(hashlib.sha512(
-                nonce.to_bytes(8, "big") + initial_hash).digest()).digest()
-            if int.from_bytes(check[:8], "big") > target:  # pragma: no cover
-                raise ArithmeticError("invalid nonce from sharded search")
-            return nonce, trials
-        base += chunks * lanes * ndev
+
+    def search_once(b_hi, b_lo):
+        return fn(ih_hi, ih_lo, t_hi, t_lo, b_hi, b_lo)
+
+    return _run_host_driver(
+        search_once, initial_hash, target, start_nonce=start_nonce,
+        trials_per_call_step=lanes * ndev, should_stop=should_stop)

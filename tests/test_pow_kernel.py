@@ -13,7 +13,7 @@ import pytest
 
 from pybitmessage_tpu.models.pow_math import pow_target, pow_value
 from pybitmessage_tpu.ops import (
-    pow_verify_batch, solve, verify,
+    PowInterrupted, pow_verify_batch, solve, verify,
 )
 from pybitmessage_tpu.ops.sha512_jax import (
     double_sha512_trial, initial_hash_words, sha512_block,
@@ -69,7 +69,7 @@ def test_solve_interruptible():
         calls.append(1)
         return len(calls) > 1
 
-    with pytest.raises(StopIteration):
+    with pytest.raises(PowInterrupted):
         # Impossible target: only value 0 passes.
         solve(initial_hash, 0, lanes=256, chunks_per_call=1,
               should_stop=stop)

@@ -5,7 +5,11 @@ import hashlib
 import jax
 import pytest
 
-from pybitmessage_tpu.parallel import make_mesh, sharded_solve
+from pybitmessage_tpu.parallel import (
+    make_mesh, make_sharded_batch_search, sharded_solve,
+)
+from pybitmessage_tpu.ops.sha512_jax import initial_hash_words
+from pybitmessage_tpu.ops.u64 import u64_from_int, u64_to_int
 
 
 def _host_trial(nonce: int, initial_hash: bytes) -> int:
@@ -27,6 +31,27 @@ def test_sharded_solve_finds_valid_nonce(n_devices):
         initial_hash, target, mesh, lanes=128, chunks_per_call=8)
     assert _host_trial(nonce, initial_hash) <= target
     assert trials % (128 * n_devices) == 0
+
+
+def test_batched_search_on_2d_mesh():
+    import jax.numpy as jnp
+    mesh = make_mesh(8, obj_axis="obj", obj_size=2)  # 2 obj groups x 4 chips
+    fn = make_sharded_batch_search(mesh, lanes=64, max_chunks=16)
+    batch = 4  # 2 per obj-group
+    ihs = [hashlib.sha512(b"obj %d" % i).digest() for i in range(batch)]
+    words = [initial_hash_words(ih) for ih in ihs]
+    ih_hi = jnp.stack([w[0] for w in words])
+    ih_lo = jnp.stack([w[1] for w in words])
+    target = 2**58
+    t_hi, t_lo = u64_from_int(target)
+    t_hi = jnp.broadcast_to(t_hi, (batch,))
+    t_lo = jnp.broadcast_to(t_lo, (batch,))
+    zero = jnp.zeros((batch,), dtype=jnp.uint32)
+    found, n_hi, n_lo, chunks = fn(ih_hi, ih_lo, t_hi, t_lo, zero, zero)
+    for i in range(batch):
+        assert bool(found[i]), "object %d unsolved" % i
+        nonce = u64_to_int(n_hi[i], n_lo[i])
+        assert _host_trial(nonce, ihs[i]) <= target
 
 
 def test_sharded_matches_host_search_region():
