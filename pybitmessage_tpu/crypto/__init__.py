@@ -1,0 +1,24 @@
+"""secp256k1 cryptography: ECIES, ECDSA, key management.
+
+A clean-room Python-3 implementation over the ``cryptography`` library
+(OpenSSL-backed) of the wire formats the Bitmessage network requires:
+
+- ECIES (reference behavior: src/pyelliptic/ecc.py:461-501): ephemeral
+  secp256k1 key -> ECDH raw X coordinate -> SHA512 KDF -> AES-256-CBC
+  (PKCS7) + HMAC-SHA256 over IV || ephem-pubkey || ciphertext.
+- ECDSA signatures with SHA256 (default) or legacy SHA1; verification
+  accepts either digest (reference: src/highlevelcrypto.py:70-108).
+- 0x02CA curve-tagged pubkey wire format with BN-style stripped
+  big-endian coordinates (reference: src/pyelliptic/ecc.py:104-115).
+- WIF private-key serialization (reference: src/shared.py:79-105).
+- Random and deterministic (passphrase-seeded) key generation
+  (reference: src/class_addressGenerator.py:119-271).
+"""
+
+from .keys import (  # noqa: F401
+    CURVE_TAG, decode_pubkey_wire, deterministic_private_key,
+    encode_pubkey_wire, grind_deterministic_keys, grind_random_keys,
+    priv_to_pub, random_private_key, wif_decode, wif_encode,
+)
+from .ecies import decrypt, encrypt  # noqa: F401
+from .signing import sign, verify  # noqa: F401

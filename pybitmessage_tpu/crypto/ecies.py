@@ -1,0 +1,93 @@
+"""ECIES over secp256k1 — Bitmessage encrypted-payload wire format.
+
+Layout (reference behavior: src/pyelliptic/ecc.py:461-501 and
+docs, encrypted payload):
+
+    IV(16) || ephem-pubkey(0x02CA-tagged) || AES-256-CBC ciphertext || MAC(32)
+
+KDF: key = SHA512(ECDH_raw_x); key_e = key[:32] (AES), key_m = key[32:]
+(HMAC-SHA256).  MAC covers everything before it.  MAC is verified in
+constant time BEFORE decryption (reference: ecc.py:497 via
+pyelliptic/hash.py equals).
+"""
+
+from __future__ import annotations
+
+import hmac as hmac_mod
+import os
+from hashlib import sha512
+
+from cryptography.hazmat.primitives import hashes, hmac, padding
+from cryptography.hazmat.primitives.asymmetric import ec
+from cryptography.hazmat.primitives.ciphers import Cipher, algorithms, modes
+
+from .keys import (
+    _priv_obj, decode_pubkey_wire, encode_pubkey_wire, priv_to_pub, pub_obj,
+    random_private_key,
+)
+
+
+class DecryptionError(ValueError):
+    """MAC mismatch or malformed payload — indistinguishable on purpose."""
+
+
+def _derive_keys(privkey: bytes, peer_pub: bytes) -> tuple[bytes, bytes]:
+    """ECDH -> SHA512 KDF -> (aes_key, mac_key).
+
+    ``cryptography``'s ECDH exchange returns the raw X coordinate padded
+    to the field size — identical to OpenSSL's ECDH_compute_key with no
+    KDF, which is what the reference hashes (ecc.py:201, 243-247).
+    """
+    shared = _priv_obj(privkey).exchange(ec.ECDH(), pub_obj(peer_pub))
+    key = sha512(shared).digest()
+    return key[:32], key[32:]
+
+
+def encrypt(data: bytes, recipient_pubkey: bytes) -> bytes:
+    """Encrypt to a 65-byte uncompressed secp256k1 public key."""
+    ephem_priv = random_private_key()
+    key_e, key_m = _derive_keys(ephem_priv, recipient_pubkey)
+
+    iv = os.urandom(16)
+    padder = padding.PKCS7(128).padder()
+    padded = padder.update(data) + padder.finalize()
+    enc = Cipher(algorithms.AES(key_e), modes.CBC(iv)).encryptor()
+    ct = enc.update(padded) + enc.finalize()
+
+    blob = iv + encode_pubkey_wire(priv_to_pub(ephem_priv)) + ct
+    mac = hmac.HMAC(key_m, hashes.SHA256())
+    mac.update(blob)
+    return blob + mac.finalize()
+
+
+def decrypt(payload: bytes, privkey: bytes) -> bytes:
+    """Decrypt an ECIES payload with a 32-byte private key.
+
+    Raises :class:`DecryptionError` on any malformation or MAC failure
+    (one exception type so callers can't leak which check failed).
+    """
+    try:
+        if len(payload) < 16 + 6 + 16 + 32:
+            raise ValueError("payload too short")
+        iv = payload[:16]
+        ephem_pub, used = decode_pubkey_wire(payload[16:len(payload) - 32])
+        ct = payload[16 + used:len(payload) - 32]
+        tag = payload[len(payload) - 32:]
+        if len(ct) == 0 or len(ct) % 16:
+            raise ValueError("bad ciphertext length")
+
+        key_e, key_m = _derive_keys(privkey, ephem_pub)
+        mac = hmac.HMAC(key_m, hashes.SHA256())
+        mac.update(payload[:len(payload) - 32])
+        expect = mac.finalize()
+        if not hmac_mod.compare_digest(expect, tag):
+            raise ValueError("MAC mismatch")
+
+        dec = Cipher(algorithms.AES(key_e), modes.CBC(iv)).decryptor()
+        padded = dec.update(ct) + dec.finalize()
+        unpadder = padding.PKCS7(128).unpadder()
+        return unpadder.update(padded) + unpadder.finalize()
+    except DecryptionError:
+        raise
+    except Exception as exc:
+        raise DecryptionError("decryption failed") from exc

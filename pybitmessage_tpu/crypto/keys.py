@@ -1,0 +1,155 @@
+"""secp256k1 key management and wire formats."""
+
+from __future__ import annotations
+
+import hashlib
+import secrets
+
+from cryptography.hazmat.primitives.asymmetric import ec
+from cryptography.hazmat.primitives.serialization import (
+    Encoding, PublicFormat,
+)
+
+from ..utils.base58 import b58decode, b58encode
+from ..utils.varint import encode_varint
+
+#: OpenSSL NID for secp256k1 — the 2-byte curve tag on wire pubkeys
+#: (reference: src/pyelliptic/openssl.py curve table; 714 == 0x02CA).
+CURVE_TAG = 714
+
+#: secp256k1 group order (SEC2); private keys must be in [1, N-1].
+_ORDER = 0xFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFEBAAEDCE6AF48A03BBFD25E8CD0364141
+
+_CURVE = ec.SECP256K1()
+
+
+def _priv_obj(privkey: bytes) -> ec.EllipticCurvePrivateKey:
+    return ec.derive_private_key(int.from_bytes(privkey, "big"), _CURVE)
+
+
+def pub_obj(pubkey: bytes) -> ec.EllipticCurvePublicKey:
+    """Build a public-key object from a 65-byte uncompressed point."""
+    return ec.EllipticCurvePublicKey.from_encoded_point(_CURVE, pubkey)
+
+
+def random_private_key() -> bytes:
+    """32 random bytes forming a valid scalar (reference grinds OpenSSL
+    rand the same way, class_addressGenerator.py:128-135)."""
+    while True:
+        key = secrets.token_bytes(32)
+        k = int.from_bytes(key, "big")
+        if 0 < k < _ORDER:
+            return key
+
+
+def deterministic_private_key(passphrase: bytes, nonce: int) -> bytes:
+    """sha512(passphrase || varint(nonce))[:32] — the deterministic-
+    address derivation (reference: class_addressGenerator.py:246-271)."""
+    return hashlib.sha512(passphrase + encode_varint(nonce)).digest()[:32]
+
+
+def grind_deterministic_keys(passphrase: bytes, leading_zeros: int = 1,
+                             start_nonce: int = 0):
+    """Find the first (signing, encryption) deterministic key pair whose
+    combined RIPE starts with ``leading_zeros`` zero bytes.
+
+    Nonce pairs (n, n+1) advance by 2 per attempt (reference:
+    class_addressGenerator.py:246-271).  Returns
+    (priv_signing, priv_encryption, ripe, signing_nonce).
+    """
+    from ..utils.hashes import address_ripe  # local import: avoid cycle
+    nonce = start_nonce
+    while True:
+        sk = deterministic_private_key(passphrase, nonce)
+        ek = deterministic_private_key(passphrase, nonce + 1)
+        ripe = address_ripe(priv_to_pub(sk), priv_to_pub(ek))
+        if ripe[:leading_zeros] == b"\x00" * leading_zeros:
+            return sk, ek, ripe, nonce
+        nonce += 2
+
+
+def grind_random_keys(leading_zeros: int = 1):
+    """Random-address grind: fixed signing key, fresh encryption keys
+    until the RIPE has the demanded zero prefix (reference:
+    class_addressGenerator.py:119-214).  Returns (sk, ek, ripe)."""
+    from ..utils.hashes import address_ripe
+    sk = random_private_key()
+    pub_sk = priv_to_pub(sk)
+    while True:
+        ek = random_private_key()
+        ripe = address_ripe(pub_sk, priv_to_pub(ek))
+        if ripe[:leading_zeros] == b"\x00" * leading_zeros:
+            return sk, ek, ripe
+
+
+def priv_to_pub(privkey: bytes) -> bytes:
+    """EC point multiplication: 32-byte scalar -> 65-byte uncompressed
+    pubkey 0x04 || X || Y (reference: highlevelcrypto.pointMult)."""
+    return _priv_obj(privkey).public_key().public_bytes(
+        Encoding.X962, PublicFormat.UncompressedPoint)
+
+
+# --- 0x02CA curve-tagged wire format ---------------------------------------
+
+def _strip(b: bytes) -> bytes:
+    """BN_bn2bin semantics: minimal big-endian encoding."""
+    s = b.lstrip(b"\x00")
+    return s if s else b"\x00"
+
+
+def encode_pubkey_wire(pubkey: bytes) -> bytes:
+    """65-byte uncompressed pubkey -> curve(2) || len(2) || X || len(2) || Y.
+
+    Coordinates are minimally encoded the way OpenSSL BN serialization
+    does (reference ephemeral keys have variable-length coordinates,
+    src/pyelliptic/ecc.py:104-115).
+    """
+    assert len(pubkey) == 65 and pubkey[0] == 4
+    x = _strip(pubkey[1:33])
+    y = _strip(pubkey[33:65])
+    return (CURVE_TAG.to_bytes(2, "big")
+            + len(x).to_bytes(2, "big") + x
+            + len(y).to_bytes(2, "big") + y)
+
+
+def decode_pubkey_wire(data: bytes) -> tuple[bytes, int]:
+    """Parse a curve-tagged pubkey; returns (65-byte pubkey, consumed).
+
+    Raises ValueError on bad tag / truncation / oversize coordinates.
+    """
+    if len(data) < 6:
+        raise ValueError("truncated pubkey")
+    if int.from_bytes(data[:2], "big") != CURVE_TAG:
+        raise ValueError("unsupported curve tag")
+    i = 2
+    coords = []
+    for _ in range(2):
+        if len(data) < i + 2:
+            raise ValueError("truncated pubkey")
+        n = int.from_bytes(data[i:i + 2], "big")
+        i += 2
+        if n > 32 or len(data) < i + n:
+            raise ValueError("bad coordinate length")
+        coords.append(data[i:i + n].rjust(32, b"\x00"))
+        i += n
+    return b"\x04" + coords[0] + coords[1], i
+
+
+# --- WIF --------------------------------------------------------------------
+
+def wif_encode(privkey: bytes) -> str:
+    """0x80 || key || first4(sha256d) in base58 (reference:
+    class_addressGenerator.py WIF encode, shared.py:79-105 decode)."""
+    raw = b"\x80" + privkey
+    check = hashlib.sha256(hashlib.sha256(raw).digest()).digest()[:4]
+    return b58encode(raw + check)
+
+
+def wif_decode(wif: str) -> bytes:
+    raw = b58decode(wif)
+    payload, check = raw[:-4], raw[-4:]
+    if hashlib.sha256(hashlib.sha256(payload).digest()).digest()[:4] != check:
+        raise ValueError("WIF checksum mismatch")
+    if not payload.startswith(b"\x80"):
+        raise ValueError("WIF missing 0x80 prefix")
+    return payload[1:]

@@ -1,0 +1,41 @@
+"""ECDSA signatures (DER) over secp256k1 with SHA256 / legacy SHA1.
+
+Reference behavior (src/highlevelcrypto.py:70-108): sign with the
+configured digest (sha256 default, sha1 legacy); verify accepts either
+digest so old-network signatures keep validating.
+"""
+
+from __future__ import annotations
+
+from cryptography.hazmat.primitives import hashes
+from cryptography.hazmat.primitives.asymmetric import ec
+
+from .keys import _priv_obj, pub_obj
+
+_DIGESTS = {"sha256": hashes.SHA256, "sha1": hashes.SHA1}
+
+
+def sign(data: bytes, privkey: bytes, digest: str = "sha256") -> bytes:
+    """DER-encoded ECDSA signature of ``data``."""
+    algo = _DIGESTS[digest]()
+    return _priv_obj(privkey).sign(data, ec.ECDSA(algo))
+
+
+def verify(data: bytes, signature: bytes, pubkey: bytes) -> bool:
+    """True if ``signature`` verifies under SHA1 *or* SHA256.
+
+    Never raises: malformed signatures/keys simply fail verification
+    (the reference wraps both attempts in bare excepts,
+    highlevelcrypto.py:90-108).
+    """
+    try:
+        key = pub_obj(pubkey)
+    except Exception:
+        return False
+    for algo in (hashes.SHA256(), hashes.SHA1()):
+        try:
+            key.verify(signature, data, ec.ECDSA(algo))
+            return True
+        except Exception:
+            continue
+    return False

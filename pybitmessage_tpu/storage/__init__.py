@@ -1,0 +1,15 @@
+"""Persistence: SQLite message store, inventory cache, known-nodes DB.
+
+Reference equivalents: src/class_sqlThread.py (schema v11 + single SQL
+thread), src/helper_sql.py (serialized access), src/storage/sqlite.py
+(inventory RAM cache + flush), src/knownnodes.py (peer DB + ratings).
+
+Design departures: Python-3 sqlite3 in WAL mode behind one lock-guarded
+connection object injected where needed (no global singletons); the
+single-writer *discipline* is kept (sqlite requires it) but implemented
+as a lock, not a dedicated thread + queue pair.
+"""
+
+from .db import Database  # noqa: F401
+from .inventory import Inventory  # noqa: F401
+from .knownnodes import KnownNodes, Peer  # noqa: F401

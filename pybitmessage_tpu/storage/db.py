@@ -1,0 +1,110 @@
+"""SQLite message store.
+
+Schema matches the reference's v11 (src/class_sqlThread.py:49-84) so the
+data model carries over one-to-one: inbox, sent, subscriptions,
+addressbook, blacklist, whitelist, pubkeys, inventory, settings,
+objectprocessorqueue.
+
+All access goes through one connection guarded by an RLock — the same
+single-writer discipline the reference enforces with a dedicated SQL
+thread + submit/return queues (src/helper_sql.py:24-35).
+"""
+
+from __future__ import annotations
+
+import sqlite3
+import threading
+import time
+from typing import Any, Iterable, Sequence
+
+SCHEMA_VERSION = 11
+
+_SCHEMA = """
+CREATE TABLE IF NOT EXISTS inbox (
+    msgid blob, toaddress text, fromaddress text, subject text,
+    received text, message text, folder text, encodingtype int,
+    read bool, sighash blob, UNIQUE(msgid) ON CONFLICT REPLACE);
+CREATE TABLE IF NOT EXISTS sent (
+    msgid blob, toaddress text, toripe blob, fromaddress text,
+    subject text, message text, ackdata blob, senttime integer,
+    lastactiontime integer, sleeptill integer, status text,
+    retrynumber integer, folder text, encodingtype int, ttl int);
+CREATE TABLE IF NOT EXISTS subscriptions (
+    label text, address text, enabled bool);
+CREATE TABLE IF NOT EXISTS addressbook (
+    label text, address text, UNIQUE(address) ON CONFLICT IGNORE);
+CREATE TABLE IF NOT EXISTS blacklist (label text, address text, enabled bool);
+CREATE TABLE IF NOT EXISTS whitelist (label text, address text, enabled bool);
+CREATE TABLE IF NOT EXISTS pubkeys (
+    address text, addressversion int, transmitdata blob, time int,
+    usedpersonally text, UNIQUE(address) ON CONFLICT REPLACE);
+CREATE TABLE IF NOT EXISTS inventory (
+    hash blob, objecttype int, streamnumber int, payload blob,
+    expirestime integer, tag blob, UNIQUE(hash) ON CONFLICT REPLACE);
+CREATE TABLE IF NOT EXISTS settings (
+    key blob, value blob, UNIQUE(key) ON CONFLICT REPLACE);
+CREATE TABLE IF NOT EXISTS objectprocessorqueue (
+    objecttype int, data blob, UNIQUE(objecttype, data) ON CONFLICT REPLACE);
+"""
+
+
+class Database:
+    """Thread-safe SQLite store.  ``path=':memory:'`` for tests."""
+
+    def __init__(self, path: str = ":memory:"):
+        self._lock = threading.RLock()
+        self._conn = sqlite3.connect(
+            path, check_same_thread=False, isolation_level=None)
+        self._conn.text_factory = str
+        with self._lock:
+            cur = self._conn.cursor()
+            if path != ":memory:":
+                cur.execute("PRAGMA journal_mode = WAL")
+            cur.execute("PRAGMA secure_delete = true")
+            cur.executescript(_SCHEMA)
+            cur.execute(
+                "INSERT OR IGNORE INTO settings VALUES('version', ?)",
+                (str(SCHEMA_VERSION),))
+            cur.execute(
+                "INSERT OR IGNORE INTO settings VALUES('lastvacuumtime', ?)",
+                (int(time.time()),))
+
+    # -- generic access ------------------------------------------------------
+
+    def execute(self, sql: str, params: Sequence[Any] = ()) -> int:
+        """Run one statement; returns rowcount."""
+        with self._lock:
+            cur = self._conn.cursor()
+            cur.execute(sql, params)
+            return cur.rowcount
+
+    def executemany(self, sql: str, rows: Iterable[Sequence[Any]]) -> None:
+        with self._lock:
+            self._conn.cursor().executemany(sql, rows)
+
+    def query(self, sql: str, params: Sequence[Any] = ()) -> list[tuple]:
+        with self._lock:
+            cur = self._conn.cursor()
+            cur.execute(sql, params)
+            return cur.fetchall()
+
+    def vacuum(self) -> None:
+        with self._lock:
+            self._conn.execute("VACUUM")
+            self.execute(
+                "UPDATE settings SET value=? WHERE key='lastvacuumtime'",
+                (int(time.time()),))
+
+    def close(self) -> None:
+        with self._lock:
+            self._conn.commit()
+            self._conn.close()
+
+    # -- settings ------------------------------------------------------------
+
+    def get_setting(self, key: str, default: str | None = None) -> str | None:
+        rows = self.query("SELECT value FROM settings WHERE key=?", (key,))
+        return rows[0][0] if rows else default
+
+    def set_setting(self, key: str, value: str) -> None:
+        self.execute("INSERT INTO settings VALUES(?, ?)", (key, value))

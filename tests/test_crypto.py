@@ -1,0 +1,133 @@
+"""Crypto layer tests with the reference's golden vectors.
+
+Vectors from the reference test data (src/tests/samples.py — public
+conformance values): known privkey→pubkey pairs, the RIPE binding both
+keys, deterministic addresses from a known passphrase.
+"""
+
+import hashlib
+from binascii import unhexlify
+
+import pytest
+
+from pybitmessage_tpu.crypto import (
+    decode_pubkey_wire, decrypt, encode_pubkey_wire, encrypt,
+    grind_deterministic_keys, priv_to_pub, random_private_key, sign,
+    verify, wif_decode, wif_encode,
+)
+from pybitmessage_tpu.crypto.ecies import DecryptionError
+from pybitmessage_tpu.models.msgcoding import (
+    EXTENDED, SIMPLE, TRIVIAL, decode_message, encode_message,
+)
+from pybitmessage_tpu.utils.addresses import encode_address
+from pybitmessage_tpu.utils.hashes import address_ripe
+
+# --- golden vectors (reference src/tests/samples.py) ------------------------
+SAMPLE_PUBSIGNINGKEY = unhexlify(
+    '044a367f049ec16cb6b6118eb734a9962d10b8db59c890cd08f210c43ff08bdf09d'
+    '16f502ca26cd0713f38988a1237f1fc8fa07b15653c996dc4013af6d15505ce')
+SAMPLE_PUBENCRYPTIONKEY = unhexlify(
+    '044597d59177fc1d89555d38915f581b5ff2286b39d022ca0283d2bdd5c36be5d3c'
+    'e7b9b97792327851a562752e4b79475d1f51f5a71352482b241227f45ed36a9')
+SAMPLE_PRIVSIGNINGKEY = unhexlify(
+    '93d0b61371a54b53df143b954035d612f8efa8a3ed1cf842c2186bfd8f876665')
+SAMPLE_PRIVENCRYPTIONKEY = unhexlify(
+    '4b0b73a54e19b059dc274ab69df095fe699f43b17397bca26fdf40f4d7400a3a')
+SAMPLE_RIPE = unhexlify('003cd097eb7f35c87b5dc8b4538c22cb55312a9f')
+
+SAMPLE_SEED = b'TIGER, tiger, burning bright. In the forests of the night'
+SAMPLE_DETERMINISTIC_ADDR3 = 'BM-2DBPTgeSawWYZceFD69AbDT5q4iUWtj1ZN'
+SAMPLE_DETERMINISTIC_ADDR4 = 'BM-2cWzSnwjJ7yRP3nLEWUV5LisTZyREWSzUK'
+
+
+def test_priv_to_pub_golden():
+    assert priv_to_pub(SAMPLE_PRIVSIGNINGKEY) == SAMPLE_PUBSIGNINGKEY
+    assert priv_to_pub(SAMPLE_PRIVENCRYPTIONKEY) == SAMPLE_PUBENCRYPTIONKEY
+
+
+def test_address_ripe_golden():
+    assert address_ripe(
+        SAMPLE_PUBSIGNINGKEY, SAMPLE_PUBENCRYPTIONKEY) == SAMPLE_RIPE
+
+
+def test_deterministic_addresses_golden():
+    # grind nonce pairs (0,1),(2,3),... until ripe[0] == 0
+    # (class_addressGenerator.py:246-271)
+    sk, ek, ripe, _ = grind_deterministic_keys(SAMPLE_SEED)
+    assert ripe == unhexlify('00cfb69416ae76f68a81c459de4e13460c7d17eb')
+    assert encode_address(3, 1, ripe) == SAMPLE_DETERMINISTIC_ADDR3
+    assert encode_address(4, 1, ripe) == SAMPLE_DETERMINISTIC_ADDR4
+
+
+def test_ecies_round_trip():
+    priv = random_private_key()
+    pub = priv_to_pub(priv)
+    for msg in (b"", b"hello bitmessage", b"x" * 5000):
+        ct = encrypt(msg, pub)
+        assert decrypt(ct, priv) == msg
+        assert ct != msg
+
+
+def test_ecies_wrong_key_fails():
+    priv, other = random_private_key(), random_private_key()
+    ct = encrypt(b"secret", priv_to_pub(priv))
+    with pytest.raises(DecryptionError):
+        decrypt(ct, other)
+
+
+def test_ecies_tamper_detected():
+    priv = random_private_key()
+    ct = bytearray(encrypt(b"secret", priv_to_pub(priv)))
+    ct[-40] ^= 1  # flip a ciphertext bit
+    with pytest.raises(DecryptionError):
+        decrypt(bytes(ct), priv)
+
+
+def test_pubkey_wire_round_trip():
+    pub = priv_to_pub(random_private_key())
+    wire = encode_pubkey_wire(pub)
+    assert wire[:2] == b"\x02\xca"
+    decoded, used = decode_pubkey_wire(wire)
+    assert used == len(wire)
+    assert decoded == pub
+
+
+def test_pubkey_wire_rejects_garbage():
+    with pytest.raises(ValueError):
+        decode_pubkey_wire(b"\x00\x01\x00\x20" + b"z" * 40)
+    with pytest.raises(ValueError):
+        decode_pubkey_wire(b"\x02\xca\x00")
+
+
+def test_sign_verify_both_digests():
+    priv = random_private_key()
+    pub = priv_to_pub(priv)
+    data = b"signed data"
+    for digest in ("sha256", "sha1"):
+        sig = sign(data, priv, digest)
+        assert verify(data, sig, pub)
+    assert not verify(b"other data", sign(data, priv), pub)
+    assert not verify(data, b"\x30\x06\x02\x01\x01\x02\x01\x01", pub)
+    assert not verify(data, b"garbage", pub)
+
+
+def test_wif_round_trip():
+    priv = SAMPLE_PRIVSIGNINGKEY
+    wif = wif_encode(priv)
+    assert wif_decode(wif) == priv
+    with pytest.raises(ValueError):
+        wif_decode(wif[:-1] + ("1" if wif[-1] != "1" else "2"))
+
+
+def test_msgcoding_round_trips():
+    for enc in (TRIVIAL, SIMPLE, EXTENDED):
+        out = decode_message(
+            encode_message("subj", "body text", enc), enc)
+        assert out.body == "body text"
+        if enc != TRIVIAL:
+            assert out.subject == "subj"
+
+
+def test_msgcoding_simple_format_exact():
+    # wire layout must match reference helper_msgcoding.py:44-58
+    assert encode_message("s", "b", SIMPLE) == b"Subject:s\nBody:b"
