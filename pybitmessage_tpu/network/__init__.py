@@ -1,0 +1,19 @@
+"""Asyncio P2P stack — the distributed communication backend.
+
+Reference: src/network/ (31 modules around a vendored asyncore loop).
+Re-designed on asyncio: one reader task per connection replaces the
+poller + 3 parser threads + per-connection locks; the wire protocol
+(24-byte framed packets, version/verack handshake, inv/getdata/object
+gossip, addr exchange, dandelion stem/fluff) is identical on the wire.
+
+- ``messages``   — payload codecs (version, addr, inv, error).
+- ``tracker``    — per-connection & global object bookkeeping.
+- ``connection`` — framed stream + command dispatch state machine.
+- ``pool``       — dialer/listener, rating-weighted peer choice,
+                   network-group diversity.
+- ``dandelion``  — stem/fluff privacy routing state.
+- ``ratelimit``  — token-bucket send/receive throttles.
+"""
+
+from .connection import BMConnection  # noqa: F401
+from .pool import ConnectionPool  # noqa: F401

@@ -1,0 +1,289 @@
+"""Connection pool: dialer, listener, gossip cadences.
+
+Reference: src/network/connectionpool.py (dial loop with rating-weighted
+choice + network-group diversity), invthread.py (1 s inv batching with
+dandelion split), downloadthread.py / uploadthread.py cadences,
+announcethread.py (not yet), knownnodes rating lifecycle on
+connect/close (tcp.py:284-300).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import random
+import time
+from typing import Callable, Optional
+
+from ..storage.knownnodes import Peer
+from .connection import BMConnection
+from .messages import AddrEntry, is_private_host, network_group
+from .ratelimit import TokenBucket
+from .tracker import GlobalTracker
+
+logger = logging.getLogger("pybitmessage_tpu.network")
+
+DEFAULT_MAX_OUTBOUND = 8
+DEFAULT_MAX_TOTAL = 200
+PING_INTERVAL = 300
+INV_INTERVAL = 1.0
+DOWNLOAD_INTERVAL = 1.0
+
+
+class NodeContext:
+    """Shared state every connection needs — the explicit replacement
+    for the reference's global singletons (state.py, queues.py,
+    BMConnectionPool(), Inventory(), Dandelion())."""
+
+    def __init__(self, *, inventory, knownnodes, dandelion=None,
+                 streams=(1,), port=8444, services=1 | 8,
+                 nonce: bytes | None = None,
+                 allow_private_peers: bool = False):
+        self.inventory = inventory
+        self.knownnodes = knownnodes
+        self.dandelion = dandelion
+        self.streams = tuple(streams)
+        self.port = port
+        self.services = services
+        self.nonce = nonce or random.getrandbits(64).to_bytes(8, "big")
+        self.allow_private_peers = allow_private_peers
+        #: kB/s-style global throttles (0 = unlimited), reference
+        #: maxdownloadrate/maxuploadrate semantics
+        self.download_bucket = TokenBucket(0)
+        self.upload_bucket = TokenBucket(0)
+        self.global_tracker = GlobalTracker()
+        #: validated objects flow out here: (hash, header, payload)
+        self.object_queue: asyncio.Queue = asyncio.Queue()
+
+
+class ConnectionPool:
+    def __init__(self, ctx: NodeContext, *,
+                 max_outbound: int = DEFAULT_MAX_OUTBOUND,
+                 max_total: int = DEFAULT_MAX_TOTAL,
+                 listen_host: str = "127.0.0.1",
+                 trusted_peer: Optional[Peer] = None):
+        self.ctx = ctx
+        self.max_outbound = max_outbound
+        self.max_total = max_total
+        self.listen_host = listen_host
+        self.trusted_peer = trusted_peer
+        self.inbound: dict[BMConnection, None] = {}
+        self.outbound: dict[BMConnection, None] = {}
+        self._server: asyncio.AbstractServer | None = None
+        self._tasks: list[asyncio.Task] = []
+        self.on_object: Callable | None = None  # hook for the processor
+
+    # -- queries -------------------------------------------------------------
+
+    def connections(self) -> list[BMConnection]:
+        return list(self.outbound) + list(self.inbound)
+
+    def established(self) -> list[BMConnection]:
+        return [c for c in self.connections() if c.fully_established]
+
+    def _used_groups(self) -> set[bytes]:
+        return {network_group(c.host) for c in self.outbound}
+
+    # -- lifecycle -----------------------------------------------------------
+
+    async def start(self, listen: bool = True) -> None:
+        if listen:
+            self._server = await asyncio.start_server(
+                self._accept, self.listen_host, self.ctx.port)
+        self._tasks = [
+            asyncio.create_task(self._dial_loop()),
+            asyncio.create_task(self._inv_loop()),
+            asyncio.create_task(self._download_loop()),
+            asyncio.create_task(self._maintenance_loop()),
+        ]
+
+    async def stop(self) -> None:
+        for t in self._tasks:
+            t.cancel()
+        await asyncio.gather(*self._tasks, return_exceptions=True)
+        if self._server:
+            self._server.close()
+        # Close connections BEFORE Server.wait_closed(): since Python
+        # 3.12 wait_closed() blocks until every handler transport is
+        # gone, so the old order deadlocks on any live connection.
+        for conn in self.connections():
+            await conn.close()
+        if self._server:
+            await self._server.wait_closed()
+
+    @property
+    def listen_port(self) -> int:
+        if self._server and self._server.sockets:
+            return self._server.sockets[0].getsockname()[1]
+        return self.ctx.port
+
+    # -- connection management ----------------------------------------------
+
+    async def _accept(self, reader: asyncio.StreamReader,
+                      writer: asyncio.StreamWriter) -> None:
+        peer = writer.get_extra_info("peername") or ("?", 0)
+        if len(self.connections()) >= self.max_total:
+            writer.close()
+            return
+        conn = BMConnection(self, reader, writer, outbound=False,
+                            host=peer[0], port=peer[1])
+        self.inbound[conn] = None
+        conn.start()
+
+    async def connect_to(self, peer: Peer) -> BMConnection | None:
+        try:
+            reader, writer = await asyncio.wait_for(
+                asyncio.open_connection(peer.host, peer.port), timeout=10)
+        except (OSError, asyncio.TimeoutError) as exc:
+            logger.debug("dial %s failed: %r", peer, exc)
+            self.ctx.knownnodes.decrease_rating(peer)
+            return None
+        conn = BMConnection(self, reader, writer, outbound=True,
+                            host=peer.host, port=peer.port)
+        self.outbound[conn] = None
+        conn.start()
+        return conn
+
+    def connection_established(self, conn: BMConnection) -> None:
+        peer = Peer(conn.host, conn.port)
+        self.ctx.knownnodes.add(peer)
+        self.ctx.knownnodes.increase_rating(peer)
+        if self.ctx.dandelion and conn.services & 8:
+            self.ctx.dandelion.maybe_add_stem(conn)
+
+    def connection_closed(self, conn: BMConnection) -> None:
+        self.inbound.pop(conn, None)
+        self.outbound.pop(conn, None)
+        if self.ctx.dandelion:
+            self.ctx.dandelion.remove_connection(conn)
+        if conn.outbound and not conn.fully_established:
+            self.ctx.knownnodes.decrease_rating(Peer(conn.host, conn.port))
+
+    def peer_discovered(self, entry: AddrEntry) -> None:
+        # Reject unroutable addresses from gossip — loopback/private/
+        # reserved hosts would poison the dial loop (the reference's
+        # addr handling only accepts private IPs from LAN UDP discovery).
+        if is_private_host(entry.host) and not self.ctx.allow_private_peers:
+            return
+        self.ctx.knownnodes.add(
+            Peer(entry.host, entry.port), entry.stream,
+            lastseen=min(int(entry.time), int(time.time())))
+
+    def object_received(self, h: bytes, header, payload: bytes,
+                        source) -> None:
+        """A new valid object arrived: queue for processing + relay."""
+        for conn in self.established():
+            if conn is not source:
+                conn.tracker.we_should_announce(h)
+        self.ctx.object_queue.put_nowait((h, header, payload))
+        if self.on_object is not None:
+            self.on_object(h, header, payload, source)
+
+    def announce_object(self, h: bytes, stream: int = 1,
+                        local: bool = True) -> None:
+        """Advertise a (locally generated or relayed) object.  Local
+        objects may enter the dandelion stem phase."""
+        dand = self.ctx.dandelion
+        if local and dand and dand.enabled and \
+                random.randrange(100) < dand.stem_probability:
+            dand.add_hash(h, stream, source=None)
+        for conn in self.established():
+            conn.tracker.we_should_announce(h)
+
+    # -- periodic tasks ------------------------------------------------------
+
+    async def _dial_loop(self) -> None:
+        while True:
+            try:
+                await self._dial_once()
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                logger.exception("dial loop error")
+            await asyncio.sleep(2)
+
+    async def _dial_once(self) -> None:
+        if self.trusted_peer is not None:
+            if not self.outbound:
+                await self.connect_to(self.trusted_peer)
+            return
+        if len(self.outbound) >= self.max_outbound:
+            return
+        peer = self.ctx.knownnodes.choose()
+        if peer is None:
+            return
+        if peer in [Peer(c.host, c.port) for c in self.outbound]:
+            return
+        # network-group diversity (anti-Sybil, connectionpool.py:303-317)
+        if network_group(peer.host) in self._used_groups():
+            return
+        await self.connect_to(peer)
+
+    async def _inv_loop(self) -> None:
+        """Per-second inv/dinv announcement batching (invthread.py)."""
+        while True:
+            await asyncio.sleep(INV_INTERVAL)
+            try:
+                await self._inv_once()
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                logger.exception("inv loop error")
+
+    async def _inv_once(self) -> None:
+        dand = self.ctx.dandelion
+        if dand:
+            for h, stream in dand.expire_fluffed():
+                for conn in self.established():
+                    conn.tracker.we_should_announce(h)
+        for conn in self.established():
+            chunk = conn.tracker.take_announcements()
+            if not chunk:
+                continue
+            fluffs, stems = [], []
+            for h in chunk:
+                child = dand.child_for(h) if dand else None
+                if child is None:
+                    fluffs.append(h)
+                elif child is conn:
+                    stems.append(h)
+                # else: in stem phase routed to another child — skip
+            random.shuffle(fluffs)
+            if fluffs:
+                await conn.announce(fluffs)
+            if stems:
+                await conn.announce(stems, stem=True)
+
+    async def _download_loop(self) -> None:
+        while True:
+            await asyncio.sleep(DOWNLOAD_INTERVAL)
+            try:
+                for conn in self.established():
+                    await conn.request_objects()
+                    # drain queued getdata backlogs (10/round cadence of
+                    # the reference's uploadthread)
+                    await conn.flush_uploads()
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                logger.exception("download loop error")
+
+    async def _maintenance_loop(self) -> None:
+        while True:
+            await asyncio.sleep(30)
+            try:
+                now = time.time()
+                self.ctx.global_tracker.expire()
+                for conn in self.connections():
+                    conn.tracker.clean()
+                    if conn.fully_established and \
+                            now - conn.last_activity > PING_INTERVAL:
+                        await conn.send_packet("ping")
+                    if now - conn.last_activity > PING_INTERVAL * 2:
+                        await conn.close()
+                if self.ctx.dandelion:
+                    self.ctx.dandelion.maybe_reassign(self.established())
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                logger.exception("maintenance loop error")

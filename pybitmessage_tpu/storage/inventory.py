@@ -14,10 +14,8 @@ import time
 from dataclasses import dataclass
 from typing import Iterable
 
+from ..models.constants import EXPIRES_GRACE
 from .db import Database
-
-#: keep objects up to 3h past expiry (reference: class_singleCleaner.py:83-90)
-EXPIRES_GRACE = 3 * 3600
 
 
 @dataclass(frozen=True)

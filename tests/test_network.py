@@ -1,0 +1,184 @@
+"""Two in-process nodes over localhost TCP: handshake, inv/getdata/object
+gossip, addr exchange.  The in-memory two-node harness the reference
+lacks (SURVEY §4 takeaway)."""
+
+import asyncio
+import time
+
+import pytest
+
+from pybitmessage_tpu.models.objects import serialize_object
+from pybitmessage_tpu.models.pow_math import pow_initial_hash, pow_target
+from pybitmessage_tpu.network.dandelion import Dandelion
+from pybitmessage_tpu.network.messages import (
+    AddrEntry, VersionPayload, decode_addr, decode_host, decode_inv,
+    encode_addr, encode_host, encode_inv, network_group,
+)
+from pybitmessage_tpu.network.pool import ConnectionPool, NodeContext
+from pybitmessage_tpu.ops import solve
+from pybitmessage_tpu.storage import Database, Inventory, KnownNodes, Peer
+from pybitmessage_tpu.utils.hashes import inventory_hash
+
+
+def _make_node(listen=True, dandelion_enabled=False):
+    db = Database(":memory:")
+    ctx = NodeContext(
+        inventory=Inventory(db),
+        knownnodes=KnownNodes(),
+        dandelion=Dandelion(enabled=dandelion_enabled),
+        port=0,
+        allow_private_peers=True,  # loopback test topology
+    )
+    pool = ConnectionPool(ctx, listen_host="127.0.0.1")
+    return ctx, pool
+
+
+def _solved_object(body: bytes, ttl: int = 600) -> bytes:
+    expires = int(time.time()) + ttl
+    obj = serialize_object(expires, 2, 1, 1, body)
+    target = pow_target(len(obj), ttl)
+    nonce, _ = solve(pow_initial_hash(obj[8:]), target,
+                     lanes=1024, chunks_per_call=8)
+    return nonce.to_bytes(8, "big") + obj[8:]
+
+
+async def _wait_for(predicate, timeout=10.0, interval=0.05):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if predicate():
+            return True
+        await asyncio.sleep(interval)
+    return False
+
+
+# --- codec unit tests -------------------------------------------------------
+
+def test_host_codec_round_trip():
+    for host in ("127.0.0.1", "8.8.8.8", "2001:db8::1"):
+        assert decode_host(encode_host(host)) == host
+
+
+def test_version_payload_round_trip():
+    v = VersionPayload(remote_host="10.1.2.3", remote_port=8445,
+                       my_port=8446, nonce=b"12345678", streams=(1, 2))
+    d = VersionPayload.decode(v.encode())
+    assert d.protocol_version == 3
+    assert d.remote_host == "10.1.2.3"
+    assert d.remote_port == 8445  # how the sender addressed us (addrRecv)
+    assert d.my_port == 8446      # the sender's own listening port (addrFrom)
+    assert d.nonce == b"12345678"
+    assert d.streams == (1, 2)
+
+
+def test_addr_codec_round_trip():
+    entries = [AddrEntry(int(time.time()), 1, 1, "9.9.9.9", 8444),
+               AddrEntry(int(time.time()), 2, 3, "2001:db8::2", 8555)]
+    out = decode_addr(encode_addr(entries))
+    assert [(e.host, e.port, e.stream) for e in out] == \
+        [("9.9.9.9", 8444, 1), ("2001:db8::2", 8555, 2)]
+
+
+def test_inv_codec():
+    hashes = [bytes([i]) * 32 for i in range(3)]
+    assert decode_inv(encode_inv(hashes)) == hashes
+
+
+def test_network_group_antisybil():
+    assert network_group("1.2.3.4") == network_group("1.2.9.9")
+    assert network_group("1.2.3.4") != network_group("1.3.3.4")
+    assert network_group("2001:db8::1") == network_group("2001:db8::2")
+
+
+# --- two-node integration ---------------------------------------------------
+
+@pytest.mark.asyncio
+async def test_two_nodes_sync_objects():
+    ctx_a, pool_a = _make_node()
+    ctx_b, pool_b = _make_node()
+
+    # node A owns an object before the nodes ever meet
+    payload = _solved_object(b"pre-existing object body")
+    h_pre = inventory_hash(payload)
+    hdr_expires = int.from_bytes(payload[8:16], "big")
+    ctx_a.inventory.add(h_pre, 2, 1, payload, hdr_expires)
+
+    await pool_a.start()
+    await pool_b.start(listen=False)
+    try:
+        conn = await pool_b.connect_to(Peer("127.0.0.1", pool_a.listen_port))
+        assert conn is not None
+        assert await _wait_for(lambda: conn.fully_established), \
+            "handshake did not complete"
+
+        # B learns of A's object via big inv and downloads it
+        assert await _wait_for(lambda: h_pre in ctx_b.inventory), \
+            "object did not sync via big inv"
+        assert ctx_b.inventory[h_pre].payload == payload
+
+        # now A generates a NEW object; B must receive it via inv gossip
+        payload2 = _solved_object(b"fresh object")
+        h2 = inventory_hash(payload2)
+        ctx_a.inventory.add(h2, 2, 1, payload2,
+                            int.from_bytes(payload2[8:16], "big"))
+        pool_a.announce_object(h2, local=True)
+        assert await _wait_for(lambda: h2 in ctx_b.inventory), \
+            "gossip of fresh object failed"
+
+        # B's received-object queue saw both
+        assert ctx_b.object_queue.qsize() == 2
+    finally:
+        await pool_b.stop()
+        await pool_a.stop()
+
+
+@pytest.mark.asyncio
+async def test_bad_pow_object_rejected_and_connection_dropped():
+    ctx_a, pool_a = _make_node()
+    ctx_b, pool_b = _make_node()
+    await pool_a.start()
+    await pool_b.start(listen=False)
+    try:
+        conn = await pool_b.connect_to(Peer("127.0.0.1", pool_a.listen_port))
+        assert await _wait_for(lambda: conn.fully_established)
+
+        expires = int(time.time()) + 600
+        bogus = serialize_object(expires, 2, 1, 1, b"no pow done", nonce=7)
+        await conn.send_packet("object", bogus)
+        # A must reject it and drop the connection
+        assert await _wait_for(lambda: not pool_a.established())
+        assert inventory_hash(bogus) not in ctx_a.inventory
+    finally:
+        await pool_b.stop()
+        await pool_a.stop()
+
+
+@pytest.mark.asyncio
+async def test_self_connection_detected():
+    ctx_a, pool_a = _make_node()
+    await pool_a.start()
+    try:
+        # same nonce on both ends -> "connection to self" detected
+        pool_b = ConnectionPool(ctx_a, listen_host="127.0.0.1")
+        conn = await pool_b.connect_to(Peer("127.0.0.1", pool_a.listen_port))
+        assert conn is not None
+        assert not await _wait_for(
+            lambda: conn.fully_established, timeout=1.0)
+    finally:
+        await pool_a.stop()
+
+
+@pytest.mark.asyncio
+async def test_addr_gossip_populates_knownnodes():
+    ctx_a, pool_a = _make_node()
+    ctx_b, pool_b = _make_node()
+    ctx_a.knownnodes.add(Peer("203.0.113.7", 8444))
+    await pool_a.start()
+    await pool_b.start(listen=False)
+    try:
+        conn = await pool_b.connect_to(Peer("127.0.0.1", pool_a.listen_port))
+        assert await _wait_for(lambda: conn.fully_established)
+        assert await _wait_for(
+            lambda: Peer("203.0.113.7", 8444) in ctx_b.knownnodes.peers())
+    finally:
+        await pool_b.stop()
+        await pool_a.stop()
