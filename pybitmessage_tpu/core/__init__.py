@@ -1,0 +1,8 @@
+"""Node: the explicit application object.
+
+Replaces the reference's global-singleton wiring (bitmessagemain.py
+Main.start + state.py/queues.py/shared.py) with one dependency-injected
+object owning storage, network, and workers.
+"""
+
+from .node import Node  # noqa: F401

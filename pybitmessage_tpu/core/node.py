@@ -1,0 +1,159 @@
+"""The Node application object: storage + network + workers, wired.
+
+Startup order mirrors the reference (bitmessagemain.py:85-287): storage
+first, key caches, workers, then networking; shutdown unwinds in
+reverse with inventory flush and knownnodes persistence
+(shutdown.py:19-91).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import time
+from pathlib import Path
+
+from ..models.payloads import gen_ack_payload
+from ..network.dandelion import Dandelion
+from ..network.pool import ConnectionPool, NodeContext
+from ..ops import solve as tpu_solve
+from ..storage import Database, Inventory, KnownNodes
+from ..storage.messages import MessageStore
+from ..utils.addresses import decode_address
+from ..workers import Cleaner, KeyStore, ObjectProcessor, SendWorker
+
+logger = logging.getLogger("pybitmessage_tpu.node")
+
+
+class Node:
+    """A complete Bitmessage node.
+
+    ``data_dir=None`` keeps everything in memory (tests).  ``solver``
+    defaults to the TPU search; inject a different callable to use the
+    C++/python ladder.
+    """
+
+    def __init__(self, data_dir: str | None = None, *,
+                 port: int = 0, listen: bool = True,
+                 solver=None, dandelion_enabled: bool = True,
+                 allow_private_peers: bool = False,
+                 stream: int = 1, test_mode: bool = False):
+        self.data_dir = Path(data_dir) if data_dir else None
+        if self.data_dir:
+            self.data_dir.mkdir(parents=True, exist_ok=True)
+        db_path = str(self.data_dir / "messages.dat") if self.data_dir \
+            else ":memory:"
+        keys_path = self.data_dir / "keys.dat" if self.data_dir else None
+        nodes_path = self.data_dir / "knownnodes.json" if self.data_dir \
+            else None
+
+        # test mode divides the consensus difficulty by 100
+        # (reference bitmessagemain.py:167-172)
+        min_ntpb = 1000 // 100 if test_mode else 1000
+        min_extra = 1000 // 100 if test_mode else 1000
+
+        self.shutdown = asyncio.Event()
+        self.db = Database(db_path)
+        self.store = MessageStore(self.db)
+        self.inventory = Inventory(self.db)
+        self.keystore = KeyStore(keys_path)
+        self.knownnodes = KnownNodes(nodes_path)
+        self.dandelion = Dandelion(enabled=dandelion_enabled)
+        self.ctx = NodeContext(
+            inventory=self.inventory, knownnodes=self.knownnodes,
+            dandelion=self.dandelion, streams=(stream,), port=port,
+            allow_private_peers=allow_private_peers,
+            pow_ntpb=min_ntpb, pow_extra=min_extra)
+        self.pool = ConnectionPool(self.ctx)
+        self.listen = listen
+        self.solver = solver or tpu_solve
+
+        self.sender = SendWorker(
+            keystore=self.keystore, store=self.store,
+            inventory=self.inventory, pool=self.pool,
+            solver=self._solve, shutdown=self.shutdown,
+            min_ntpb=min_ntpb, min_extra=min_extra)
+        self.processor = ObjectProcessor(
+            keystore=self.keystore, store=self.store,
+            inventory=self.inventory, sender=self.sender, pool=self.pool,
+            shutdown=self.shutdown,
+            min_ntpb=min_ntpb, min_extra=min_extra)
+        self.cleaner = Cleaner(
+            inventory=self.inventory, store=self.store,
+            knownnodes=self.knownnodes, sender=self.sender, pool=self.pool,
+            shutdown=self.shutdown)
+        self._pump_task: asyncio.Task | None = None
+
+    def _solve(self, initial_hash, target, should_stop=None):
+        return self.solver(initial_hash, target, should_stop=should_stop)
+
+    # -- lifecycle -----------------------------------------------------------
+
+    async def start(self) -> None:
+        self.sender.start()
+        self.processor.start()
+        self.cleaner.start()
+        await self.pool.start(listen=self.listen)
+        self._pump_task = asyncio.create_task(self._pump_objects())
+        logger.info("node started (port %s)",
+                    self.pool.listen_port if self.listen else "-")
+
+    async def _pump_objects(self) -> None:
+        """Forward validated network objects to the processor."""
+        while not self.shutdown.is_set():
+            h, header, payload = await self.ctx.object_queue.get()
+            await self.processor.queue.put(payload)
+
+    async def stop(self) -> None:
+        """Orderly shutdown (reference shutdown.py:19-91)."""
+        self.shutdown.set()
+        if self._pump_task:
+            self._pump_task.cancel()
+        await self.pool.stop()
+        await self.sender.stop()
+        await self.processor.stop()
+        await self.cleaner.stop()
+        self.inventory.flush()
+        self.knownnodes.save()
+        self.db.close()
+        logger.info("node stopped")
+
+    # -- high-level API (used by the RPC layer and tests) --------------------
+
+    def create_identity(self, label: str = "", *, deterministic: bytes | None
+                        = None, chan: bool = False):
+        if deterministic is not None:
+            return self.keystore.create_deterministic(
+                deterministic, label, chan=chan)
+        return self.keystore.create_random(label)
+
+    async def send_message(self, to_address: str, from_address: str,
+                           subject: str, body: str, *,
+                           ttl: int = 4 * 24 * 3600,
+                           encoding: int = 2) -> bytes:
+        """Queue a message; returns its ackdata handle."""
+        to = decode_address(to_address)  # validates
+        ack = gen_ack_payload(to.stream, 0)
+        self.store.queue_sent(
+            msgid=os.urandom(16), toaddress=to_address, toripe=to.ripe,
+            fromaddress=from_address, subject=subject, message=body,
+            ackdata=ack, ttl=ttl, encoding=encoding)
+        await self.sender.queue.put(("sendmessage",))
+        return ack
+
+    async def send_broadcast(self, from_address: str, subject: str,
+                             body: str, *, ttl: int = 4 * 24 * 3600,
+                             encoding: int = 2) -> bytes:
+        ack = gen_ack_payload(1, 0)
+        self.store.queue_sent(
+            msgid=os.urandom(16), toaddress="[Broadcast]", toripe=b"",
+            fromaddress=from_address, subject=subject, message=body,
+            ackdata=ack, ttl=ttl, encoding=encoding,
+            status="broadcastqueued")
+        await self.sender.queue.put(("sendbroadcast",))
+        return ack
+
+    def message_status(self, ackdata: bytes) -> str:
+        m = self.store.sent_by_ackdata(ackdata)
+        return m.status if m else "notfound"

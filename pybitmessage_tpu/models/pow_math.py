@@ -23,13 +23,20 @@ def pow_target(
     ttl: int,
     nonce_trials_per_byte: int = DEFAULT_NONCE_TRIALS_PER_BYTE,
     extra_bytes: int = DEFAULT_EXTRA_BYTES,
+    clamp: bool = True,
 ) -> int:
     """Target threshold for a payload of ``payload_length`` bytes
-    (nonce included) living for ``ttl`` seconds."""
-    if nonce_trials_per_byte < DEFAULT_NONCE_TRIALS_PER_BYTE:
-        nonce_trials_per_byte = DEFAULT_NONCE_TRIALS_PER_BYTE
-    if extra_bytes < DEFAULT_EXTRA_BYTES:
-        extra_bytes = DEFAULT_EXTRA_BYTES
+    (nonce included) living for ``ttl`` seconds.
+
+    ``clamp=False`` skips the network-minimum floor — used by test mode,
+    which divides the consensus difficulty by 100 the way the reference
+    does (bitmessagemain.py:167-172).
+    """
+    if clamp:
+        if nonce_trials_per_byte < DEFAULT_NONCE_TRIALS_PER_BYTE:
+            nonce_trials_per_byte = DEFAULT_NONCE_TRIALS_PER_BYTE
+        if extra_bytes < DEFAULT_EXTRA_BYTES:
+            extra_bytes = DEFAULT_EXTRA_BYTES
     weight = payload_length + extra_bytes
     return 2**64 // (nonce_trials_per_byte * (weight + (ttl * weight) // 2**16))
 
@@ -50,19 +57,22 @@ def check_pow(
     nonce_trials_per_byte: int = 0,
     extra_bytes: int = 0,
     recv_time: float = 0,
+    clamp: bool = True,
 ) -> bool:
     """Validate an object's embedded PoW (reference: protocol.py:258-286).
 
     ``object_bytes`` = nonce(8) || expires(8) || type(4) || ...
     TTL is clamped to >= 300s so stale objects still verify cheaply.
+    ``clamp=False`` honors sub-minimum difficulty values (test mode).
     """
     expires = int.from_bytes(object_bytes[8:16], "big")
     ttl = expires - int(recv_time if recv_time else time.time())
     ttl = max(ttl, 300)
     target = pow_target(
         len(object_bytes), ttl,
-        max(nonce_trials_per_byte, DEFAULT_NONCE_TRIALS_PER_BYTE),
-        max(extra_bytes, DEFAULT_EXTRA_BYTES),
+        nonce_trials_per_byte or DEFAULT_NONCE_TRIALS_PER_BYTE,
+        extra_bytes or DEFAULT_EXTRA_BYTES,
+        clamp=clamp,
     )
     return pow_value(object_bytes) <= target
 

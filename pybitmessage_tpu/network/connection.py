@@ -15,6 +15,7 @@ import logging
 import random
 import struct
 import time
+from collections import deque
 from typing import TYPE_CHECKING
 
 from ..models.constants import (
@@ -27,6 +28,7 @@ from ..models.packet import (
 )
 from ..models.pow_math import check_pow
 from ..utils.hashes import inventory_hash
+from ..utils.varint import VarintError
 from .messages import (
     AddrEntry, MessageError, VersionPayload, decode_addr, decode_inv,
     encode_addr, encode_error, encode_host, encode_inv,
@@ -71,7 +73,7 @@ class BMConnection:
         self.fully_established = False
         self.last_activity = time.time()
         self._closed = False
-        self.pending_upload: list[bytes] = []
+        self.pending_upload: deque[bytes] = deque()
         self._task: asyncio.Task | None = None
 
     # -- lifecycle -----------------------------------------------------------
@@ -86,7 +88,7 @@ class BMConnection:
                 await self.send_version()
             while True:
                 await self._read_packet()
-        except (ConnectionClosed, PacketError, MessageError,
+        except (ConnectionClosed, PacketError, MessageError, VarintError,
                 asyncio.IncompleteReadError, ConnectionError, OSError) as exc:
             logger.debug("connection %s:%s closed: %r",
                          self.host, self.port, exc)
@@ -176,6 +178,10 @@ class BMConnection:
         self.services = ver.services
         self.streams = ver.streams
         self.user_agent = ver.user_agent
+        if not self.outbound:
+            # knownnodes/addr-gossip must use the peer's advertised
+            # LISTENING port, not the ephemeral source port we accepted
+            self.port = ver.my_port
         await self.send_packet("verack")
         self.verack_sent = True
         if not self.outbound:
@@ -215,9 +221,16 @@ class BMConnection:
             await self.send_packet("addr", encode_addr(entries))
 
     async def _send_big_inv(self) -> None:
-        """Advertise our whole unexpired inventory per stream."""
+        """Advertise our whole unexpired inventory per stream —
+        excluding objects still in the dandelion stem phase, which must
+        not be linkable to us (reference tcp.py:210-253 excludes the
+        Dandelion hashMap)."""
+        dand = self.ctx.dandelion
         for stream in self.ctx.streams:
-            hashes = self.ctx.inventory.unexpired_hashes_by_stream(stream)
+            hashes = [
+                h for h in self.ctx.inventory.unexpired_hashes_by_stream(
+                    stream)
+                if dand is None or not dand.in_stem_phase(h)]
             for i in range(0, len(hashes), BIG_INV_CHUNK):
                 chunk = hashes[i:i + BIG_INV_CHUNK]
                 await self.send_packet("inv", encode_inv(chunk))
@@ -245,19 +258,30 @@ class BMConnection:
             self.tracker.object_received(h)
             return
         self.tracker.peer_announced(h)
+        # a peer advertising more un-fetched objects than the whole
+        # protocol allows is attacking our memory (reference
+        # MAX_OBJECT_COUNT disconnect)
+        if len(self.tracker.objects_new_to_me) > MAX_OBJECT_COUNT:
+            raise ConnectionClosed("peer advertised too many objects")
 
     async def cmd_getdata(self, payload: bytes) -> None:
         self._require_established()
         for h in decode_inv(payload):
+            if len(self.pending_upload) >= MAX_OBJECT_COUNT:
+                break  # bounded backlog: a getdata flood can't grow memory
             self.pending_upload.append(h)
         await self.flush_uploads()
 
     async def flush_uploads(self, limit: int = 10) -> None:
         """Serve up to ``limit`` queued getdata requests
-        (reference uploadthread.py:15-69)."""
+        (reference uploadthread.py:15-69).  Objects still in the
+        dandelion stem phase are withheld as if unknown."""
+        dand = self.ctx.dandelion
         served = 0
         while self.pending_upload and served < limit:
-            h = self.pending_upload.pop(0)
+            h = self.pending_upload.popleft()
+            if dand is not None and dand.in_stem_phase(h):
+                continue
             try:
                 item = self.ctx.inventory[h]
             except KeyError:
@@ -277,7 +301,8 @@ class BMConnection:
             return
         if header.stream not in self.ctx.streams:
             return
-        if not check_pow(payload):
+        if not check_pow(payload, self.ctx.pow_ntpb, self.ctx.pow_extra,
+                         clamp=False):
             logger.debug("insufficient PoW from %s", self.host)
             raise ConnectionClosed("object with insufficient PoW")
         h = inventory_hash(payload)
@@ -285,9 +310,12 @@ class BMConnection:
         self.ctx.global_tracker.received(h)
         if h in self.ctx.inventory:
             return
+        # getpubkey/pubkey carry a tag from v4; broadcast only from v5
+        # (a v4 broadcast's first 32 bytes are ciphertext, not a tag)
+        tagged = (header.object_type in (0, 1) and header.version >= 4) or \
+                 (header.object_type == 3 and header.version >= 5)
         tag = b""
-        if header.object_type in (0, 1, 3) and header.version >= 4 \
-                and len(payload) >= header.header_length + 32:
+        if tagged and len(payload) >= header.header_length + 32:
             tag = payload[header.header_length:header.header_length + 32]
         self.ctx.inventory.add(
             h, header.object_type, header.stream, payload, header.expires,

@@ -38,7 +38,8 @@ class NodeContext:
     def __init__(self, *, inventory, knownnodes, dandelion=None,
                  streams=(1,), port=8444, services=1 | 8,
                  nonce: bytes | None = None,
-                 allow_private_peers: bool = False):
+                 allow_private_peers: bool = False,
+                 pow_ntpb: int = 1000, pow_extra: int = 1000):
         self.inventory = inventory
         self.knownnodes = knownnodes
         self.dandelion = dandelion
@@ -47,6 +48,11 @@ class NodeContext:
         self.services = services
         self.nonce = nonce or random.getrandbits(64).to_bytes(8, "big")
         self.allow_private_peers = allow_private_peers
+        #: network-minimum PoW params this node enforces; test mode
+        #: divides the consensus 1000/1000 by 100 (reference
+        #: bitmessagemain.py:167-172)
+        self.pow_ntpb = pow_ntpb
+        self.pow_extra = pow_extra
         #: kB/s-style global throttles (0 = unlimited), reference
         #: maxdownloadrate/maxuploadrate semantics
         self.download_bucket = TokenBucket(0)

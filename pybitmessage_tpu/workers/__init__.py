@@ -1,0 +1,18 @@
+"""Application workers: send pipeline, object processor, cleaner.
+
+Reference: the four non-network threads of the runtime —
+class_singleWorker.py (send state machine + PoW dispatch),
+class_objectProcessor.py (decrypt/verify/store pipeline),
+class_addressGenerator.py (key grinding, in ``crypto.keys``),
+class_singleCleaner.py (housekeeping cadences).
+
+Re-design: asyncio tasks over explicit dependencies (KeyStore,
+MessageStore, Inventory, ConnectionPool) instead of global singletons;
+PoW runs on TPU through the solver ladder; incoming-object PoW is
+*batch*-verified on device.
+"""
+
+from .keystore import KeyStore, OwnIdentity, Subscription  # noqa: F401
+from .sender import SendWorker  # noqa: F401
+from .processor import ObjectProcessor  # noqa: F401
+from .cleaner import Cleaner  # noqa: F401

@@ -1,0 +1,69 @@
+"""Housekeeping task (reference: class_singleCleaner.py).
+
+Every cycle: flush the inventory RAM cache to SQL; periodically purge
+expired inventory, stale pubkeys, resend overdue messages, clean
+knownnodes, and expire download requests.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+
+logger = logging.getLogger("pybitmessage_tpu.cleaner")
+
+FLUSH_INTERVAL = 300
+DEEP_CLEAN_INTERVAL = 7200
+
+
+class Cleaner:
+    def __init__(self, *, inventory, store, knownnodes, sender=None,
+                 pool=None, flush_interval: float = FLUSH_INTERVAL,
+                 shutdown: asyncio.Event | None = None):
+        self.inventory = inventory
+        self.store = store
+        self.knownnodes = knownnodes
+        self.sender = sender
+        self.pool = pool
+        self.flush_interval = flush_interval
+        self.shutdown = shutdown or asyncio.Event()
+        self._task: asyncio.Task | None = None
+        self._last_deep_clean = 0.0
+
+    def start(self) -> asyncio.Task:
+        self._task = asyncio.create_task(self._run())
+        return self._task
+
+    async def stop(self) -> None:
+        if self._task:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+
+    async def _run(self) -> None:
+        while not self.shutdown.is_set():
+            await asyncio.sleep(self.flush_interval)
+            try:
+                await self.run_once()
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                logger.exception("cleaner cycle failed")
+
+    async def run_once(self) -> None:
+        self.inventory.flush()
+        if time.time() - self._last_deep_clean >= DEEP_CLEAN_INTERVAL:
+            self._last_deep_clean = time.time()
+            self.inventory.clean()
+            purged = self.store.purge_stale_pubkeys()
+            dropped = self.knownnodes.cleanup()
+            self.knownnodes.save()
+            if self.pool is not None:
+                self.pool.ctx.global_tracker.expire()
+            if self.sender is not None:
+                await self.sender.resend_stale()
+            logger.info("deep clean: %d pubkeys purged, %d peers dropped",
+                        purged, dropped)

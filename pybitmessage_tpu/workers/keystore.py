@@ -1,0 +1,208 @@
+"""Own identities and broadcast subscriptions with derived-key caches.
+
+Reference: src/shared.py:108-184 — ``myECCryptorObjects`` (ripe ->
+decryptor), ``myAddressesByHash``/``ByTag``, and
+``MyECSubscriptionCryptorObjects`` rebuilt from keys.dat and the
+subscriptions table.  Here the caches live on an explicit KeyStore
+object; keys persist in an INI file (keys.dat equivalent).
+"""
+
+from __future__ import annotations
+
+import configparser
+import time
+from dataclasses import dataclass, field
+from pathlib import Path
+
+from ..crypto import (
+    grind_deterministic_keys, grind_random_keys, priv_to_pub, wif_decode,
+    wif_encode,
+)
+from ..models.constants import (
+    DEFAULT_EXTRA_BYTES, DEFAULT_NONCE_TRIALS_PER_BYTE,
+)
+from ..models.payloads import broadcast_v4_key, double_hash_of_address_data
+from ..utils.addresses import decode_address, encode_address
+from ..utils.hashes import address_ripe
+
+
+@dataclass
+class OwnIdentity:
+    label: str
+    address: str
+    version: int
+    stream: int
+    ripe: bytes
+    priv_signing: bytes
+    priv_encryption: bytes
+    nonce_trials_per_byte: int = DEFAULT_NONCE_TRIALS_PER_BYTE
+    extra_bytes: int = DEFAULT_EXTRA_BYTES
+    chan: bool = False
+    enabled: bool = True
+    last_pubkey_send_time: int = 0
+
+    @property
+    def pub_signing_key(self) -> bytes:
+        return priv_to_pub(self.priv_signing)
+
+    @property
+    def pub_encryption_key(self) -> bytes:
+        return priv_to_pub(self.priv_encryption)
+
+    @property
+    def tag(self) -> bytes:
+        """v4 pubkey-object tag (double hash [32:])."""
+        return double_hash_of_address_data(
+            self.version, self.stream, self.ripe)[32:]
+
+
+@dataclass
+class Subscription:
+    label: str
+    address: str
+    enabled: bool = True
+    # derived at load time:
+    version: int = 0
+    stream: int = 0
+    ripe: bytes = b""
+
+    @property
+    def broadcast_key(self) -> bytes:
+        """Private key every subscriber derives from the address itself
+        (class_singleWorker.py:648-665)."""
+        if self.version <= 3:
+            return broadcast_v4_key(self.version, self.stream, self.ripe)
+        return double_hash_of_address_data(
+            self.version, self.stream, self.ripe)[:32]
+
+    @property
+    def tag(self) -> bytes:
+        return double_hash_of_address_data(
+            self.version, self.stream, self.ripe)[32:]
+
+
+class KeyStore:
+    def __init__(self, path: str | Path | None = None):
+        self._path = Path(path) if path else None
+        self.identities: dict[str, OwnIdentity] = {}
+        self.by_ripe: dict[bytes, OwnIdentity] = {}
+        self.by_tag: dict[bytes, OwnIdentity] = {}
+        self.subscriptions: dict[str, Subscription] = {}
+        if self._path and self._path.exists():
+            self.load()
+
+    # -- identity management -------------------------------------------------
+
+    def _index(self, ident: OwnIdentity) -> None:
+        self.identities[ident.address] = ident
+        self.by_ripe[ident.ripe] = ident
+        self.by_tag[ident.tag] = ident
+
+    def create_random(self, label: str = "", *, version: int = 4,
+                      stream: int = 1, leading_zeros: int = 1) -> OwnIdentity:
+        sk, ek, ripe = grind_random_keys(leading_zeros)
+        return self._register(label, version, stream, ripe, sk, ek)
+
+    def create_deterministic(self, passphrase: bytes, label: str = "", *,
+                             version: int = 4, stream: int = 1,
+                             chan: bool = False) -> OwnIdentity:
+        sk, ek, ripe, _ = grind_deterministic_keys(passphrase)
+        return self._register(label, version, stream, ripe, sk, ek,
+                              chan=chan)
+
+    def _register(self, label, version, stream, ripe, sk, ek,
+                  chan=False) -> OwnIdentity:
+        ident = OwnIdentity(
+            label, encode_address(version, stream, ripe), version, stream,
+            ripe, sk, ek, chan=chan)
+        self._index(ident)
+        self.save()
+        return ident
+
+    def get(self, address: str) -> OwnIdentity | None:
+        return self.identities.get(address)
+
+    def owns(self, address: str) -> bool:
+        return address in self.identities
+
+    # -- subscriptions -------------------------------------------------------
+
+    def subscribe(self, address: str, label: str = "") -> Subscription:
+        a = decode_address(address)
+        sub = Subscription(label, address, True, a.version, a.stream, a.ripe)
+        self.subscriptions[address] = sub
+        self.save()
+        return sub
+
+    def unsubscribe(self, address: str) -> None:
+        self.subscriptions.pop(address, None)
+        self.save()
+
+    def active_subscriptions(self) -> list[Subscription]:
+        return [s for s in self.subscriptions.values() if s.enabled]
+
+    # -- persistence (keys.dat-style INI) ------------------------------------
+
+    def save(self) -> None:
+        if not self._path:
+            return
+        cfg = configparser.ConfigParser()
+        cfg.optionxform = str  # base58 addresses are case-sensitive
+        for ident in self.identities.values():
+            cfg[ident.address] = {
+                "label": ident.label,
+                "enabled": str(ident.enabled).lower(),
+                "privsigningkey": wif_encode(ident.priv_signing),
+                "privencryptionkey": wif_encode(ident.priv_encryption),
+                "noncetrialsperbyte": str(ident.nonce_trials_per_byte),
+                "payloadlengthextrabytes": str(ident.extra_bytes),
+                "chan": str(ident.chan).lower(),
+                "lastpubkeysendtime": str(ident.last_pubkey_send_time),
+            }
+        if self.subscriptions:
+            cfg["subscriptions"] = {
+                s.address: s.label for s in self.subscriptions.values()}
+        tmp = self._path.with_suffix(".tmp")
+        with open(tmp, "w") as f:
+            cfg.write(f)
+        tmp.replace(self._path)
+        try:
+            self._path.chmod(0o600)  # keyfile perms (shared.py:197-255)
+        except OSError:
+            pass
+
+    def load(self) -> None:
+        cfg = configparser.ConfigParser()
+        cfg.optionxform = str  # base58 addresses are case-sensitive
+        cfg.read(self._path)
+        for section in cfg.sections():
+            if not section.startswith("BM-"):
+                if section == "subscriptions":
+                    for addr, label in cfg[section].items():
+                        try:
+                            self.subscribe(addr if addr.startswith("BM-")
+                                           else "BM-" + addr, label)
+                        except Exception:
+                            continue
+                continue
+            s = cfg[section]
+            a = decode_address(section)
+            sk = wif_decode(s["privsigningkey"])
+            ek = wif_decode(s["privencryptionkey"])
+            ripe = address_ripe(priv_to_pub(sk), priv_to_pub(ek))
+            ident = OwnIdentity(
+                s.get("label", ""), section, a.version, a.stream, ripe,
+                sk, ek,
+                int(s.get("noncetrialsperbyte",
+                          DEFAULT_NONCE_TRIALS_PER_BYTE)),
+                int(s.get("payloadlengthextrabytes", DEFAULT_EXTRA_BYTES)),
+                s.get("chan", "false") == "true",
+                s.get("enabled", "true") == "true",
+                int(s.get("lastpubkeysendtime", 0)))
+            self._index(ident)
+
+    def touch_pubkey_sent(self, address: str) -> None:
+        ident = self.identities.get(address)
+        if ident:
+            ident.last_pubkey_send_time = int(time.time())
+            self.save()

@@ -1,0 +1,341 @@
+"""Incoming-object processor: ack matching, pubkey/msg/broadcast pipelines.
+
+Reference: class_objectProcessor.py — checkackdata (129-154),
+processgetpubkey (176-268), processpubkey (270-433), processmsg
+(435-747) with randomized decrypt-all-keys and anti-surreptitious-
+forwarding, processbroadcast (749-973).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import random
+import struct
+import time
+
+from ..crypto import decrypt, verify
+from ..crypto.ecies import DecryptionError
+from ..models import msgcoding
+from ..models.constants import (
+    DEFAULT_EXTRA_BYTES, DEFAULT_NONCE_TRIALS_PER_BYTE, OBJECT_BROADCAST,
+    OBJECT_GETPUBKEY, OBJECT_MSG, OBJECT_PUBKEY,
+)
+from ..models.objects import ObjectHeader
+from ..models.payloads import (
+    BroadcastPlaintext, MsgPlaintext, PayloadError,
+    bitfield_does_ack, broadcast_signed_data, double_hash_of_address_data,
+    msg_signed_data, parse_pubkey_inner,
+)
+from ..models.pow_math import pow_target, pow_value
+from ..storage.messages import ACKRECEIVED, MessageStore
+from ..utils.addresses import encode_address
+from ..utils.hashes import address_ripe, inventory_hash, sha512
+from ..utils.varint import decode_varint, encode_varint
+from .keystore import KeyStore
+from .sender import SendWorker
+
+logger = logging.getLogger("pybitmessage_tpu.processor")
+
+#: don't resend our pubkey more often than this (objectProcessor.py:176-268)
+PUBKEY_RESEND_INTERVAL = 28 * 24 * 3600
+
+
+class ObjectProcessor:
+    """Consumes validated objects from the network object queue."""
+
+    def __init__(self, *, keystore: KeyStore, store: MessageStore,
+                 inventory, sender: SendWorker, pool=None,
+                 shutdown: asyncio.Event | None = None,
+                 min_ntpb: int = DEFAULT_NONCE_TRIALS_PER_BYTE,
+                 min_extra: int = DEFAULT_EXTRA_BYTES):
+        self.keystore = keystore
+        self.store = store
+        self.inventory = inventory
+        self.sender = sender
+        self.pool = pool
+        self.shutdown = shutdown or asyncio.Event()
+        self.min_ntpb = min_ntpb
+        self.min_extra = min_extra
+        self.queue: asyncio.Queue = asyncio.Queue()
+        self._task: asyncio.Task | None = None
+        # observability counters (reference state.numberOf*Processed)
+        self.messages_processed = 0
+        self.broadcasts_processed = 0
+        self.pubkeys_processed = 0
+
+    def start(self) -> asyncio.Task:
+        self._task = asyncio.create_task(self._run())
+        return self._task
+
+    async def stop(self) -> None:
+        if self._task:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+
+    async def _run(self) -> None:
+        while not self.shutdown.is_set():
+            payload = await self.queue.get()
+            try:
+                await self.process(payload)
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                logger.exception("object processing failed")
+
+    async def process(self, payload: bytes) -> None:
+        try:
+            header = ObjectHeader.parse(payload)
+        except Exception:
+            return
+        if header.object_type == OBJECT_GETPUBKEY:
+            await self._process_getpubkey(header, payload)
+        elif header.object_type == OBJECT_PUBKEY:
+            self._process_pubkey(header, payload)
+        elif header.object_type == OBJECT_MSG:
+            await self._process_msg(header, payload)
+        elif header.object_type == OBJECT_BROADCAST:
+            self._process_broadcast(header, payload)
+
+    # -- acks ----------------------------------------------------------------
+
+    def _check_ackdata(self, payload: bytes) -> bool:
+        """Match objects against our ack watchlist: bytes from offset 16
+        (type+version+stream+body) equal a watched ackdata
+        (objectProcessor.py:129-154)."""
+        if len(payload) < 32:
+            return False
+        ack = payload[16:]
+        if ack in self.sender.watched_acks:
+            self.sender.watched_acks.discard(ack)
+            self.store.update_sent_status(ack, ACKRECEIVED)
+            logger.info("ack received for one of our messages")
+            return True
+        return False
+
+    # -- getpubkey -----------------------------------------------------------
+
+    async def _process_getpubkey(self, header: ObjectHeader,
+                                 payload: bytes) -> None:
+        i = header.header_length
+        ident = None
+        if header.version <= 3:
+            ripe = payload[i:i + 20]
+            ident = self.keystore.by_ripe.get(ripe)
+        elif header.version == 4:
+            tag = payload[i:i + 32]
+            ident = self.keystore.by_tag.get(tag)
+        if ident is None or ident.chan:
+            return
+        if header.version != ident.version:
+            return
+        if time.time() - ident.last_pubkey_send_time < \
+                PUBKEY_RESEND_INTERVAL:
+            logger.debug("pubkey for %s sent recently; not resending",
+                         ident.address)
+            return
+        logger.info("peer requested our pubkey for %s", ident.address)
+        await self.sender.queue.put(("sendpubkey", ident.address))
+
+    # -- pubkey --------------------------------------------------------------
+
+    def _process_pubkey(self, header: ObjectHeader, payload: bytes) -> None:
+        self.pubkeys_processed += 1
+        i = header.header_length
+        if header.version in (2, 3):
+            data = parse_pubkey_inner(payload[i:], header.version,
+                                      header.stream)
+            if header.version == 3:
+                # sig covers payload[8:] through the difficulty varints
+                # (objectProcessor.py:362-371)
+                span = _difficulty_span(payload, i + 4 + 128)
+                signed = payload[8:i + 4 + 128 + len(span)]
+                if not verify(signed, data.signature, data.pub_signing_key):
+                    logger.debug("v3 pubkey bad signature")
+                    return
+            ripe = address_ripe(data.pub_signing_key,
+                                data.pub_encryption_key)
+            address = encode_address(header.version, header.stream, ripe)
+            self._store_pubkey(address, header.version, payload[i:])
+        elif header.version == 4:
+            tag = payload[i:i + 32]
+            # can only decrypt if we're awaiting this tag
+            toaddress = self.sender.needed_pubkeys.get(tag)
+            if toaddress is None:
+                return
+            from ..utils.addresses import decode_address
+            to = decode_address(toaddress)
+            data = self.sender._decrypt_pubkey_object(payload, to)
+            if data is None:
+                logger.debug("v4 pubkey failed decrypt/verify")
+                return
+            from .sender import _pubkey_inner_bytes
+            self._store_pubkey(toaddress, 4, _pubkey_inner_bytes(data),
+                               used_personally=True)
+            del self.sender.needed_pubkeys[tag]
+
+    def _store_pubkey(self, address: str, version: int, inner: bytes,
+                      used_personally: bool = False) -> None:
+        self.store.store_pubkey(address, version, inner, used_personally)
+        logger.info("stored pubkey for %s", address)
+        # unblock any sends waiting on it (possibleNewPubkey analog)
+        waiting = self.store.sent_by_status("awaitingpubkey")
+        if any(m.toaddress == address for m in waiting):
+            for m in waiting:
+                if m.toaddress == address:
+                    self.store.update_sent_status(m.ackdata, "msgqueued")
+            self.sender.queue.put_nowait(("sendmessage",))
+
+    # -- msg -----------------------------------------------------------------
+
+    async def _process_msg(self, header: ObjectHeader,
+                           payload: bytes) -> None:
+        self.messages_processed += 1
+        if self._check_ackdata(payload):
+            return
+        i = header.header_length
+        encrypted = payload[i:]
+
+        # try-decrypt against all our keys in RANDOMIZED order,
+        # continuing after success to blunt timing attacks
+        # (objectProcessor.py:459-477)
+        decrypted = None
+        match = None
+        idents = list(self.keystore.identities.values())
+        random.shuffle(idents)
+        for ident in idents:
+            try:
+                out = decrypt(encrypted, ident.priv_encryption)
+                if decrypted is None:
+                    decrypted, match = out, ident
+            except DecryptionError:
+                continue
+        if decrypted is None:
+            return
+
+        try:
+            plain = MsgPlaintext.decode(decrypted)
+        except PayloadError as exc:
+            logger.debug("undecodable msg bound for us: %s", exc)
+            return
+        # anti-surreptitious-forwarding: embedded ripe must be OURS
+        # (objectProcessor.py:531-540)
+        if plain.dest_ripe != match.ripe:
+            logger.warning("surreptitious forwarding attempt blocked")
+            return
+        signed = msg_signed_data(payload, header.version, header.stream,
+                                 decrypted[:plain.signed_span])
+        if not verify(signed, plain.signature, plain.pub_signing_key):
+            logger.debug("msg signature invalid")
+            return
+        # demanded-difficulty recheck (objectProcessor.py:615-629)
+        if not self.keystore.get(match.address).chan:
+            req_ntpb = max(match.nonce_trials_per_byte, self.min_ntpb)
+            req_extra = max(match.extra_bytes, self.min_extra)
+            ttl = max(header.expires - int(time.time()), 300)
+            demanded = pow_target(len(payload), ttl, req_ntpb, req_extra,
+                                  clamp=False)
+            if pow_value(payload) > demanded:
+                logger.info("msg PoW below our demanded difficulty")
+                return
+
+        sender_ripe = address_ripe(plain.pub_signing_key,
+                                   plain.pub_encryption_key)
+        from_address = encode_address(plain.sender_version,
+                                      plain.sender_stream, sender_ripe)
+        sighash = sha512(plain.signature)
+        body = msgcoding.decode_message(plain.message, plain.encoding)
+        if not self.store.deliver_inbox(
+                msgid=inventory_hash(payload), toaddress=match.address,
+                fromaddress=from_address, subject=body.subject,
+                message=body.body, encoding=plain.encoding,
+                sighash=sighash):
+            logger.debug("duplicate message dropped (sighash)")
+            return
+        logger.info("message delivered: %s -> %s", from_address,
+                    match.address)
+        # flood the sender's pre-made ack (objectProcessor.py:723-731)
+        if plain.ack_data and bitfield_does_ack(plain.bitfield):
+            await self._emit_ack(plain.ack_data)
+
+    async def _emit_ack(self, ack_packet: bytes) -> None:
+        """The embedded ack is a full wire packet; strip the 24-byte
+        header and flood the object (bmproto.py:684-710)."""
+        if len(ack_packet) < 24 + 22:
+            return
+        obj = ack_packet[24:]
+        try:
+            hdr = ObjectHeader.parse(obj)
+            hdr.check_expiry()
+        except Exception:
+            return
+        from ..models.pow_math import check_pow
+        if not check_pow(obj, self.min_ntpb, self.min_extra, clamp=False):
+            return
+        h = inventory_hash(obj)
+        if h in self.inventory:
+            return
+        self.inventory.add(h, hdr.object_type, hdr.stream, obj, hdr.expires)
+        if self.pool is not None:
+            self.pool.announce_object(h, hdr.stream, local=False)
+        logger.info("flooded sender's ack object")
+
+    # -- broadcast -----------------------------------------------------------
+
+    def _process_broadcast(self, header: ObjectHeader,
+                           payload: bytes) -> None:
+        self.broadcasts_processed += 1
+        i = header.header_length
+        if header.version == 5:
+            tag = payload[i:i + 32]
+            i += 32
+            subs = [s for s in self.keystore.active_subscriptions()
+                    if s.tag == tag]
+        elif header.version == 4:
+            subs = [s for s in self.keystore.active_subscriptions()
+                    if s.version <= 3]
+        else:
+            return
+        encrypted = payload[i:]
+        for sub in subs:
+            try:
+                decrypted = decrypt(encrypted, sub.broadcast_key)
+            except DecryptionError:
+                continue
+            try:
+                plain = BroadcastPlaintext.decode(decrypted)
+            except PayloadError:
+                continue
+            sender_ripe = address_ripe(plain.pub_signing_key,
+                                       plain.pub_encryption_key)
+            if sender_ripe != sub.ripe:
+                logger.warning("broadcast key/ripe mismatch")
+                continue
+            signed = broadcast_signed_data(
+                payload[8:header.header_length
+                        + (32 if header.version == 5 else 0)],
+                decrypted[:plain.signed_span])
+            if not verify(signed, plain.signature, plain.pub_signing_key):
+                logger.debug("broadcast signature invalid")
+                continue
+            body = msgcoding.decode_message(plain.message, plain.encoding)
+            self.store.deliver_inbox(
+                msgid=inventory_hash(payload), toaddress="[Broadcast]",
+                fromaddress=sub.address, subject=body.subject,
+                message=body.body, encoding=plain.encoding,
+                sighash=sha512(plain.signature))
+            logger.info("broadcast delivered from %s", sub.address)
+            return
+
+
+def _difficulty_span(payload: bytes, offset: int) -> bytes:
+    """The two difficulty varints of a v3 pubkey (for signature data)."""
+    i = offset
+    _, n = decode_varint(payload, i)
+    i += n
+    _, n = decode_varint(payload, i)
+    i += n
+    return payload[offset:i]

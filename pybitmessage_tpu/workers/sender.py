@@ -1,0 +1,434 @@
+"""Send pipeline: the sent-message state machine + PoW dispatch.
+
+Reference: class_singleWorker.py — sendMsg (717-1373), sendBroadcast
+(532-715), sendOutOrStoreMyV4Pubkey (417-530), requestPubKey
+(1375-1493).  States: msgqueued -> (doingpubkeypow -> awaitingpubkey)
+-> doingmsgpow -> msgsent -> ackreceived, with retry backoff
+TTL*2^retries at 1.1*TTL intervals.
+
+The PoW runs through an injected solver (TPU ladder); every solve is
+interruptible via the node's shutdown flag.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import logging
+import random
+import struct
+import time
+from typing import Awaitable, Callable
+
+from ..crypto import decrypt, encrypt, sign, verify
+from ..crypto.ecies import DecryptionError
+from ..models import msgcoding
+from ..models.constants import (
+    DEFAULT_EXTRA_BYTES, DEFAULT_NONCE_TRIALS_PER_BYTE, OBJECT_MSG,
+    OBJECT_PUBKEY, RIDICULOUS_DIFFICULTY,
+)
+from ..models.payloads import (
+    MsgPlaintext, BroadcastPlaintext, PayloadError, PubkeyData,
+    ack_ttl_bucket, assemble_getpubkey, assemble_pubkey,
+    broadcast_signed_data, double_hash_of_address_data, gen_ack_payload,
+    get_bitfield, bitfield_does_ack, msg_signed_data, object_shell,
+    parse_pubkey_inner,
+)
+from ..models.pow_math import pow_target
+from ..storage.messages import (
+    AWAITINGPUBKEY, BROADCASTSENT, DOINGMSGPOW, MSGQUEUED, MSGSENT,
+    MSGSENTNOACKEXPECTED, MessageStore,
+)
+from ..utils.addresses import decode_address
+from ..utils.hashes import inventory_hash, sha512
+from ..utils.varint import decode_varint, encode_varint
+from .keystore import KeyStore, OwnIdentity
+
+logger = logging.getLogger("pybitmessage_tpu.worker")
+
+#: re-request a pubkey after this long (class_singleWorker.py getpubkey)
+GETPUBKEY_RETRY = 2.5 * 24 * 3600
+
+
+def _jitter_ttl(ttl: int) -> int:
+    return max(300, int(ttl + random.randrange(-300, 300)))
+
+
+class SendWorker:
+    """Consumes send commands; drives the sent table state machine."""
+
+    def __init__(self, *, keystore: KeyStore, store: MessageStore,
+                 inventory, pool, solver: Callable,
+                 shutdown: asyncio.Event | None = None,
+                 min_ntpb: int = DEFAULT_NONCE_TRIALS_PER_BYTE,
+                 min_extra: int = DEFAULT_EXTRA_BYTES):
+        self.keystore = keystore
+        self.store = store
+        self.inventory = inventory
+        self.pool = pool
+        self.solver = solver  # solve(initial_hash, target) -> (nonce, trials)
+        self.min_ntpb = min_ntpb    # network-minimum PoW (test mode: /100)
+        self.min_extra = min_extra
+        self.shutdown = shutdown or asyncio.Event()
+        self.queue: asyncio.Queue = asyncio.Queue()
+        #: ackdata payloads we watch for (state.ackdataForWhichImWatching)
+        self.watched_acks: set[bytes] = set()
+        #: tag -> address for pubkeys we await (state.neededPubkeys analog)
+        self.needed_pubkeys: dict[bytes, str] = {}
+        self._task: asyncio.Task | None = None
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> asyncio.Task:
+        self.store.reset_interrupted_pow()
+        self._rebuild_watchlists()
+        # initial sweep: anything re-queued by reset_interrupted_pow (or
+        # left queued at last shutdown) gets processed without waiting
+        # for a new command (reference worker startup behavior)
+        self.queue.put_nowait(("sendmessage",))
+        self.queue.put_nowait(("sendbroadcast",))
+        self._task = asyncio.create_task(self._run())
+        return self._task
+
+    async def stop(self) -> None:
+        if self._task:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+
+    def _rebuild_watchlists(self) -> None:
+        """Recover state from the sent table (class_singleWorker.py:72-117)."""
+        for m in self.store.sent_by_status(MSGSENT, DOINGMSGPOW):
+            self.watched_acks.add(m.ackdata)
+        for m in self.store.sent_by_status(AWAITINGPUBKEY, "doingpubkeypow"):
+            try:
+                a = decode_address(m.toaddress)
+            except Exception:
+                continue
+            tag = double_hash_of_address_data(a.version, a.stream, a.ripe)[32:]
+            self.needed_pubkeys[tag] = m.toaddress
+
+    async def _run(self) -> None:
+        while not self.shutdown.is_set():
+            try:
+                cmd = await self.queue.get()
+            except asyncio.CancelledError:
+                raise
+            try:
+                await self._dispatch(cmd)
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                logger.exception("send worker command failed: %r", cmd[:1])
+
+    async def _dispatch(self, cmd: tuple) -> None:
+        kind = cmd[0]
+        if kind == "sendmessage":
+            await self.process_queued_messages()
+        elif kind == "sendbroadcast":
+            await self.process_queued_broadcasts()
+        elif kind == "sendpubkey":
+            await self.send_my_pubkey(cmd[1])
+        else:
+            logger.warning("unknown worker command %r", kind)
+
+    # -- PoW helper ----------------------------------------------------------
+
+    async def _do_pow(self, payload_sans_nonce: bytes, ttl: int,
+                      ntpb: int = 0, extra: int = 0) -> bytes:
+        """Solve and prepend the nonce (class_singleWorker._doPOWDefaults)."""
+        target = pow_target(len(payload_sans_nonce) + 8, ttl,
+                            ntpb or self.min_ntpb, extra or self.min_extra,
+                            clamp=False)
+        initial = sha512(payload_sans_nonce)
+        t0 = time.monotonic()
+        loop = asyncio.get_running_loop()
+        nonce, trials = await loop.run_in_executor(
+            None, lambda: self.solver(initial, target,
+                                      should_stop=self.shutdown.is_set))
+        dt = max(time.monotonic() - t0, 1e-9)
+        logger.info("PoW done: %d trials in %.2fs (%.0f H/s)",
+                    trials, dt, trials / dt)
+        return struct.pack(">Q", nonce) + payload_sans_nonce
+
+    def _publish(self, payload: bytes, object_type: int, stream: int,
+                 tag: bytes = b"") -> bytes:
+        h = inventory_hash(payload)
+        expires = int.from_bytes(payload[8:16], "big")
+        self.inventory.add(h, object_type, stream, payload, expires, tag)
+        if self.pool is not None:
+            self.pool.announce_object(h, stream, local=True)
+        return h
+
+    # -- msg sending ---------------------------------------------------------
+
+    async def process_queued_messages(self) -> None:
+        for m in self.store.sent_by_status(MSGQUEUED, "forcepow"):
+            if self.shutdown.is_set():
+                return
+            await self._send_one_msg(m)
+
+    async def _send_one_msg(self, m) -> None:
+        to = decode_address(m.toaddress)
+        sender = self.keystore.get(m.fromaddress)
+        if sender is None:
+            logger.error("own address %s missing from keystore",
+                         m.fromaddress)
+            self.store.update_sent_status(m.ackdata, "badkey")
+            return
+
+        if self.keystore.owns(m.toaddress):
+            recipient = self.keystore.get(m.toaddress)
+            pub_enc = recipient.pub_encryption_key
+            their_ntpb = self.min_ntpb
+            their_extra = self.min_extra
+            their_bitfield_acks = False  # no ack to self/chan
+        else:
+            pubkey = self._lookup_pubkey(to, m.toaddress)
+            if pubkey is None:
+                await self._request_pubkey(to, m.toaddress, m.ackdata)
+                return
+            their_ntpb = max(pubkey.nonce_trials_per_byte, self.min_ntpb)
+            their_extra = max(pubkey.extra_bytes, self.min_extra)
+            if their_ntpb > RIDICULOUS_DIFFICULTY or \
+                    their_extra > RIDICULOUS_DIFFICULTY:
+                self.store.update_sent_status(m.ackdata, "toodifficult")
+                return
+            pub_enc = pubkey.pub_encryption_key
+            their_bitfield_acks = bitfield_does_ack(pubkey.bitfield)
+
+        self.store.update_sent_status(m.ackdata, DOINGMSGPOW)
+        ttl = _jitter_ttl(m.ttl or 4 * 24 * 3600)
+        expires = int(time.time()) + ttl
+
+        # optional pre-PoW'd ack packet embedded in the plaintext
+        ack_packet = b""
+        if not self.keystore.owns(m.toaddress) and their_bitfield_acks:
+            ack_packet = await self._make_full_ack(m.ackdata, to.stream, ttl)
+
+        body = msgcoding.encode_message(m.subject, m.message,
+                                        m.encodingtype or 2)
+        plain = MsgPlaintext(
+            sender_version=sender.version, sender_stream=sender.stream,
+            bitfield=get_bitfield(True),
+            pub_signing_key=sender.pub_signing_key,
+            pub_encryption_key=sender.pub_encryption_key,
+            nonce_trials_per_byte=sender.nonce_trials_per_byte,
+            extra_bytes=sender.extra_bytes,
+            dest_ripe=to.ripe, encoding=m.encodingtype or 2,
+            message=body, ack_data=ack_packet)
+        unsigned = plain.encode_unsigned()
+        # signature covers expires+type+msgver+stream+plaintext-to-ack
+        # (class_singleWorker.py:1224-1228)
+        signed_data = (struct.pack(">Q", expires) + b"\x00\x00\x00\x02"
+                       + encode_varint(1) + encode_varint(to.stream)
+                       + unsigned)
+        plain.signature = sign(signed_data, sender.priv_signing)
+
+        encrypted = encrypt(plain.encode(), pub_enc)
+        payload = (struct.pack(">Q", expires) + b"\x00\x00\x00\x02"
+                   + encode_varint(1) + encode_varint(to.stream) + encrypted)
+        payload = await self._do_pow(payload, ttl, their_ntpb, their_extra)
+        h = self._publish(payload, OBJECT_MSG, to.stream)
+        logger.info("msg sent, inventory hash %s", h.hex())
+
+        if self.keystore.owns(m.toaddress):
+            # loopback: deliver straight to our inbox
+            # (class_singleWorker.py:1350-1373)
+            sighash = sha512(plain.signature)
+            self.store.deliver_inbox(
+                msgid=h, toaddress=m.toaddress, fromaddress=m.fromaddress,
+                subject=m.subject, message=m.message,
+                encoding=m.encodingtype or 2, sighash=sighash)
+            self.store.update_sent_status(m.ackdata, ACK_STATUS_SELF)
+        elif ack_packet:
+            self.watched_acks.add(m.ackdata)
+            self.store.update_sent_status(
+                m.ackdata, MSGSENT,
+                sleeptill=int(time.time() + 1.1 * ttl))
+        else:
+            self.store.update_sent_status(m.ackdata, MSGSENTNOACKEXPECTED)
+
+    async def _make_full_ack(self, ackdata: bytes, stream: int,
+                             ttl: int) -> bytes:
+        """Pre-PoW'd ack the recipient floods back verbatim
+        (generateFullAckMessage, class_singleWorker.py:1495-1519)."""
+        ack_ttl = _jitter_ttl(ack_ttl_bucket(ttl))
+        expires = int(time.time()) + ack_ttl
+        payload = struct.pack(">Q", expires) + ackdata
+        payload = await self._do_pow(payload, ack_ttl)
+        from ..models.packet import pack_packet
+        return pack_packet("object", payload)
+
+    # -- pubkey lookup / request ---------------------------------------------
+
+    def _lookup_pubkey(self, to, toaddress: str) -> PubkeyData | None:
+        raw = self.store.get_pubkey(toaddress)
+        if raw is not None:
+            return parse_pubkey_inner(raw, to.version, to.stream)
+        if to.version >= 4:
+            # look in the inventory for tagged pubkey objects we can
+            # decrypt (protocol.py:401-529 decryptAndCheckPubkeyPayload)
+            tag = double_hash_of_address_data(
+                to.version, to.stream, to.ripe)[32:]
+            for item in self.inventory.by_type_and_tag(OBJECT_PUBKEY, tag):
+                data = self._decrypt_pubkey_object(item.payload, to)
+                if data is not None:
+                    self.store.store_pubkey(
+                        toaddress, to.version,
+                        _pubkey_inner_bytes(data), used_personally=True)
+                    return data
+        return None
+
+    def _decrypt_pubkey_object(self, payload: bytes, to) -> PubkeyData | None:
+        try:
+            from ..models.objects import ObjectHeader
+            hdr = ObjectHeader.parse(payload)
+            if hdr.version != to.version:
+                return None
+            dh = double_hash_of_address_data(to.version, to.stream, to.ripe)
+            blob = payload[hdr.header_length + 32:]
+            inner = decrypt(blob, dh[:32])
+            data = parse_pubkey_inner(inner, to.version, to.stream)
+            # verify: sig covers payload-through-tag + inner-through-extra
+            span = 4 + 64 + 64
+            i = span
+            _, n = decode_varint(inner, i)
+            i += n
+            _, n = decode_varint(inner, i)
+            i += n
+            signed = payload[8:hdr.header_length + 32] + inner[:i]
+            if not verify(signed, data.signature, data.pub_signing_key):
+                return None
+            from ..utils.hashes import address_ripe
+            if address_ripe(data.pub_signing_key,
+                            data.pub_encryption_key) != to.ripe:
+                return None
+            return data
+        except (DecryptionError, PayloadError, Exception):
+            return None
+
+    async def _request_pubkey(self, to, toaddress: str,
+                              ackdata: bytes) -> None:
+        tag = double_hash_of_address_data(to.version, to.stream, to.ripe)[32:]
+        if tag in self.needed_pubkeys:
+            self.store.update_sent_status(ackdata, AWAITINGPUBKEY)
+            return  # already requested
+        self.needed_pubkeys[tag] = toaddress
+        ttl = _jitter_ttl(int(GETPUBKEY_RETRY / 2.5))
+        expires = int(time.time()) + ttl
+        payload = assemble_getpubkey(expires, to.version, to.stream, to.ripe)
+        payload = await self._do_pow(payload, ttl)
+        self._publish(payload, 0, to.stream)
+        self.store.update_sent_status(
+            ackdata, AWAITINGPUBKEY,
+            sleeptill=int(time.time() + GETPUBKEY_RETRY))
+        logger.info("requested pubkey for %s", toaddress)
+
+    # -- own pubkey publication ----------------------------------------------
+
+    async def send_my_pubkey(self, address: str) -> None:
+        ident = self.keystore.get(address)
+        if ident is None:
+            return
+        ttl = _jitter_ttl(28 * 24 * 3600)
+        expires = int(time.time()) + ttl
+        data = PubkeyData(
+            ident.version, ident.stream, get_bitfield(True),
+            ident.pub_signing_key, ident.pub_encryption_key,
+            ident.nonce_trials_per_byte, ident.extra_bytes)
+        payload = assemble_pubkey(
+            expires, data, ident.ripe,
+            sign_fn=lambda d: sign(d, ident.priv_signing))
+        payload = await self._do_pow(payload, ttl)
+        tag = ident.tag if ident.version >= 4 else b""
+        self._publish(payload, OBJECT_PUBKEY, ident.stream, tag)
+        self.keystore.touch_pubkey_sent(address)
+        logger.info("published pubkey for %s", address)
+
+    # -- broadcast sending ---------------------------------------------------
+
+    async def process_queued_broadcasts(self) -> None:
+        for m in self.store.sent_by_status("broadcastqueued"):
+            if self.shutdown.is_set():
+                return
+            await self._send_one_broadcast(m)
+
+    async def _send_one_broadcast(self, m) -> None:
+        sender = self.keystore.get(m.fromaddress)
+        if sender is None:
+            self.store.update_sent_status(m.ackdata, "badkey")
+            return
+        ttl = _jitter_ttl(min(max(m.ttl or 4 * 24 * 3600, 3600),
+                              28 * 24 * 3600))
+        expires = int(time.time()) + ttl
+        obj_version = 4 if sender.version <= 3 else 5
+        shell = (struct.pack(">Q", expires) + b"\x00\x00\x00\x03"
+                 + encode_varint(obj_version)
+                 + encode_varint(sender.stream))
+        dh = double_hash_of_address_data(
+            sender.version, sender.stream, sender.ripe)
+        tag = b""
+        if sender.version >= 4:
+            tag = dh[32:]
+            shell += tag
+
+        body = msgcoding.encode_message(m.subject, m.message,
+                                        m.encodingtype or 2)
+        plain = BroadcastPlaintext(
+            sender.version, sender.stream, get_bitfield(True),
+            sender.pub_signing_key, sender.pub_encryption_key,
+            sender.nonce_trials_per_byte, sender.extra_bytes,
+            m.encodingtype or 2, body)
+        unsigned = plain.encode_unsigned()
+        plain.signature = sign(broadcast_signed_data(shell, unsigned),
+                               sender.priv_signing)
+        if sender.version <= 3:
+            from ..models.payloads import broadcast_v4_key
+            key = broadcast_v4_key(sender.version, sender.stream, sender.ripe)
+        else:
+            key = dh[:32]
+        from ..crypto import priv_to_pub
+        payload = shell + encrypt(plain.encode(), priv_to_pub(key))
+        payload = await self._do_pow(payload, ttl)
+        h = self._publish(payload, 3, sender.stream, tag)
+        self.store.update_sent_status(m.ackdata, BROADCASTSENT)
+        logger.info("broadcast sent, hash %s", h.hex())
+
+    # -- resend (cleaner hook) ----------------------------------------------
+
+    async def resend_stale(self) -> None:
+        """Re-queue messages whose sleeptill passed, doubling TTL
+        (class_singleCleaner.py:92-106, singleWorker.py:900-904)."""
+        for m in self.store.due_for_resend():
+            new_ttl = min(m.ttl * 2, 28 * 24 * 3600)
+            self.store.bump_retry(m.ackdata, new_ttl, 0)
+            if m.status == AWAITINGPUBKEY:
+                try:
+                    to = decode_address(m.toaddress)
+                except Exception:
+                    continue
+                tag = double_hash_of_address_data(
+                    to.version, to.stream, to.ripe)[32:]
+                self.needed_pubkeys.pop(tag, None)
+                self.store.update_sent_status(m.ackdata, MSGQUEUED)
+            else:
+                self.watched_acks.discard(m.ackdata)
+                self.store.update_sent_status(m.ackdata, MSGQUEUED)
+            await self.queue.put(("sendmessage",))
+
+
+ACK_STATUS_SELF = "ackreceived"  # self/chan sends complete immediately
+
+
+def _pubkey_inner_bytes(data: PubkeyData) -> bytes:
+    """Serialize the pubkey body the way the pubkeys table stores it."""
+    out = data.bitfield + data.pub_signing_key[1:] + \
+        data.pub_encryption_key[1:]
+    if data.address_version >= 3:
+        out += encode_varint(data.nonce_trials_per_byte)
+        out += encode_varint(data.extra_bytes)
+        out += encode_varint(len(data.signature)) + data.signature
+    return out
