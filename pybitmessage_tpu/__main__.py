@@ -1,0 +1,91 @@
+"""Daemon entry point: ``python -m pybitmessage_tpu``.
+
+Reference: src/bitmessagemain.py Main.start() — single process, clean
+shutdown on SIGINT/SIGTERM, optional test mode (-t) and trusted peer.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+import signal
+import sys
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="pybitmessage_tpu",
+        description="TPU-native Bitmessage node")
+    p.add_argument("-d", "--data-dir", default=None,
+                   help="data directory (default: in-memory)")
+    p.add_argument("-p", "--port", type=int, default=8444,
+                   help="P2P listen port")
+    p.add_argument("--no-listen", action="store_true",
+                   help="outbound connections only")
+    p.add_argument("--api-port", type=int, default=8442)
+    p.add_argument("--no-api", action="store_true")
+    p.add_argument("--api-user", default="")
+    p.add_argument("--api-password", default="")
+    p.add_argument("-t", "--test-mode", action="store_true",
+                   help="divide PoW difficulty by 100 (reference -t)")
+    p.add_argument("--trusted-peer", default=None, metavar="HOST:PORT",
+                   help="connect only to this peer")
+    p.add_argument("--no-dandelion", action="store_true")
+    p.add_argument("--seed-defaults", action="store_true",
+                   help="seed the bootstrap nodes into knownnodes")
+    p.add_argument("-v", "--verbose", action="store_true")
+    return p
+
+
+async def run(args) -> int:
+    from .api import APIServer
+    from .core import Node
+    from .storage.knownnodes import Peer
+
+    node = Node(args.data_dir, port=args.port, listen=not args.no_listen,
+                test_mode=args.test_mode,
+                dandelion_enabled=not args.no_dandelion)
+    if args.trusted_peer:
+        host, _, port = args.trusted_peer.rpartition(":")
+        node.pool.trusted_peer = Peer(host, int(port))
+    if args.seed_defaults:
+        node.knownnodes.seed_defaults()
+
+    await node.start()
+    api = None
+    if not args.no_api:
+        api = APIServer(node, port=args.api_port,
+                        username=args.api_user,
+                        password=args.api_password)
+        await api.start()
+        logging.info("API listening on 127.0.0.1:%d", api.listen_port)
+
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        try:
+            loop.add_signal_handler(sig, stop.set)
+        except NotImplementedError:  # pragma: no cover (non-unix)
+            pass
+    await stop.wait()
+    logging.info("shutting down...")
+    if api is not None:
+        await api.stop()
+    await node.stop()
+    return 0
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    logging.basicConfig(
+        level=logging.DEBUG if args.verbose else logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s")
+    try:
+        return asyncio.run(run(args))
+    except KeyboardInterrupt:  # pragma: no cover
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,503 @@
+"""API command handlers over a Node.
+
+Same command names, semantics, and error codes as the reference
+(src/api.py:111-153 error table, 550-1500 handlers); subjects/bodies
+are base64 on the wire exactly as the reference's API encodes them.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import base64
+import json
+from binascii import hexlify, unhexlify
+
+from ..models.constants import OBJECT_MSG, OBJECT_PUBKEY
+from ..models.objects import ObjectHeader
+from ..models.pow_math import check_pow
+from ..utils.addresses import AddressError, decode_address, with_bm_prefix
+from ..utils.hashes import inventory_hash
+
+#: reference error table (api.py:111-153)
+ERROR_CODES = {
+    0: "Invalid command parameters number",
+    1: "The specified passphrase is blank.",
+    2: "The address version number currently must be 3, 4, or 0 (which "
+       "means auto-select).",
+    3: "The stream number must be 1 (or 0 which means auto-select). "
+       "Others aren't supported.",
+    4: "Why would you ask me to generate 0 addresses for you?",
+    5: "You have (accidentally?) specified too many addresses to make.",
+    6: "The encoding type must be 2 or 3.",
+    7: "Could not decode address",
+    8: "Checksum failed for address",
+    9: "Invalid characters in address",
+    10: "Address version number too high (or zero)",
+    11: "The address version number currently must be 2, 3 or 4. Others "
+        "aren't supported. Check the address.",
+    12: "The stream number must be 1. Others aren't supported. Check the "
+        "address.",
+    13: "Could not find this address in your keys.dat file.",
+    14: "Your fromAddress is disabled. Cannot send.",
+    15: "Invalid ackData object size.",
+    16: "You are already subscribed to that address.",
+    17: "Label is not valid UTF-8 data.",
+    18: "Chan name does not match address.",
+    19: "The length of hash should be 32 bytes (encoded in hex thus 64 "
+        "characters).",
+    20: "Invalid method:",
+    21: "Unexpected API Failure",
+    22: "Decode error",
+    23: "Bool expected in eighteenByteRipe",
+    24: "Chan address is already present.",
+    25: "Specified address is not a chan address. Use deleteAddress API "
+        "call instead.",
+    26: "Malformed varint in address: ",
+    27: "Message is too long.",
+}
+
+_ADDRESS_ERROR_TO_CODE = {
+    "checksumfailed": 8,
+    "invalidcharacters": 9,
+    "versiontoohigh": 10,
+    "varintmalformed": 26,
+    "ripetoolong": 25,
+    "ripetooshort": 25,
+}
+
+
+class APIError(Exception):
+    def __init__(self, code: int, detail: str = ""):
+        self.code = code
+        msg = ERROR_CODES.get(code, "Unknown error")
+        super().__init__(f"API Error {code:04d}: {msg}"
+                         + (f" {detail}" if detail else ""))
+
+
+def _b64(s) -> str:
+    if isinstance(s, str):
+        s = s.encode("utf-8")
+    return base64.b64encode(s).decode("ascii")
+
+
+def _from_b64(s: str, code: int = 22) -> str:
+    try:
+        return base64.b64decode(s).decode("utf-8")
+    except Exception as exc:
+        raise APIError(code, str(exc))
+
+
+class CommandHandler:
+    """All RPC commands; dispatch by method name."""
+
+    def __init__(self, node):
+        self.node = node
+
+    async def dispatch(self, method: str, params: list):
+        handler = getattr(self, "cmd_" + method, None)
+        if handler is None:
+            raise APIError(20, method)
+        try:
+            result = handler(*params)
+            if hasattr(result, "__await__"):
+                result = await result
+            return result
+        except APIError:
+            raise
+        except AddressError as exc:
+            raise APIError(_ADDRESS_ERROR_TO_CODE.get(exc.status, 7),
+                           str(exc))
+        except TypeError as exc:
+            if "positional argument" in str(exc):
+                raise APIError(0, str(exc))
+            raise APIError(21, str(exc))
+        except Exception as exc:
+            raise APIError(21, repr(exc))
+
+    # -- trivial / diagnostics ----------------------------------------------
+
+    def cmd_helloWorld(self, a, b):
+        return f"{a}-{b}"
+
+    def cmd_add(self, a, b):
+        return a + b
+
+    def cmd_statusBar(self, message):
+        return None  # no GUI yet; accepted for conformance
+
+    # -- addresses -----------------------------------------------------------
+
+    def cmd_decodeAddress(self, address):
+        a = decode_address(address)
+        return json.dumps({
+            "status": "success", "addressVersion": a.version,
+            "streamNumber": a.stream, "ripe": _b64(a.ripe)})
+
+    def cmd_listAddresses(self):
+        out = []
+        for ident in self.node.keystore.identities.values():
+            out.append({
+                "label": ident.label, "address": ident.address,
+                "stream": ident.stream, "enabled": ident.enabled,
+                "chan": ident.chan})
+        return json.dumps({"addresses": out}, indent=4)
+
+    def cmd_createRandomAddress(self, label, eighteenByteRipe=False,
+                                *_ignored):
+        if not isinstance(eighteenByteRipe, bool):
+            raise APIError(23)
+        label = _from_b64(label, 17)
+        ident = self.node.keystore.create_random(
+            label, leading_zeros=2 if eighteenByteRipe else 1)
+        self.node.sender.queue.put_nowait(("sendpubkey", ident.address))
+        return ident.address
+
+    def cmd_createDeterministicAddresses(
+            self, passphrase, numberOfAddresses=1, addressVersionNumber=0,
+            streamNumber=0, eighteenByteRipe=False, *_ignored):
+        passphrase = _from_b64(passphrase, 1)
+        if not passphrase:
+            raise APIError(1)
+        if numberOfAddresses == 0:
+            raise APIError(4)
+        if numberOfAddresses > 999:
+            raise APIError(5)
+        if addressVersionNumber not in (0, 3, 4):
+            raise APIError(2)
+        if streamNumber not in (0, 1):
+            raise APIError(3)
+        addresses = []
+        nonce = 0
+        for _ in range(numberOfAddresses):
+            from ..crypto import grind_deterministic_keys
+            from ..utils.hashes import address_ripe  # noqa: F401
+            sk, ek, ripe, nonce = grind_deterministic_keys(
+                passphrase.encode("utf-8"), start_nonce=nonce)
+            ident = self.node.keystore._register(
+                "", addressVersionNumber or 4, streamNumber or 1, ripe,
+                sk, ek)
+            addresses.append(ident.address)
+            nonce += 2
+        return json.dumps({"addresses": addresses}, indent=4)
+
+    def cmd_getDeterministicAddress(self, passphrase,
+                                    addressVersionNumber=4,
+                                    streamNumber=1):
+        passphrase = _from_b64(passphrase, 1)
+        if not passphrase:
+            raise APIError(1)
+        if addressVersionNumber not in (3, 4):
+            raise APIError(2)
+        if streamNumber != 1:
+            raise APIError(3)
+        from ..crypto import grind_deterministic_keys
+        from ..utils.addresses import encode_address
+        _, _, ripe, _ = grind_deterministic_keys(passphrase.encode("utf-8"))
+        return encode_address(addressVersionNumber, streamNumber, ripe)
+
+    def cmd_createChan(self, passphrase):
+        passphrase_raw = _from_b64(passphrase, 1)
+        if not passphrase_raw:
+            raise APIError(1)
+        ident = self.node.keystore.create_deterministic(
+            passphrase_raw.encode("utf-8"), f"[chan] {passphrase_raw}",
+            chan=True)
+        return ident.address
+
+    def cmd_joinChan(self, passphrase, address):
+        passphrase_raw = _from_b64(passphrase, 1)
+        if not passphrase_raw:
+            raise APIError(1)
+        decode_address(address)
+        if self.node.keystore.owns(address):
+            raise APIError(24)
+        ident = self.node.keystore.create_deterministic(
+            passphrase_raw.encode("utf-8"), f"[chan] {passphrase_raw}",
+            chan=True)
+        if ident.address != address:
+            # keystore now contains the derived address; report mismatch
+            raise APIError(18)
+        return "success"
+
+    def cmd_leaveChan(self, address):
+        ident = self.node.keystore.get(address)
+        if ident is None:
+            raise APIError(13)
+        if not ident.chan:
+            raise APIError(25)
+        self._delete_identity(address)
+        return "success"
+
+    def cmd_deleteAddress(self, address):
+        if not self.node.keystore.owns(address):
+            raise APIError(13)
+        self._delete_identity(address)
+        return "success"
+
+    def _delete_identity(self, address):
+        ks = self.node.keystore
+        ident = ks.identities.pop(address)
+        ks.by_ripe.pop(ident.ripe, None)
+        ks.by_tag.pop(ident.tag, None)
+        ks.save()
+
+    def cmd_enableAddress(self, address, enable=True):
+        ident = self.node.keystore.get(address)
+        if ident is None:
+            raise APIError(13)
+        ident.enabled = bool(enable)
+        self.node.keystore.save()
+        return "success"
+
+    # -- address book --------------------------------------------------------
+
+    def cmd_listAddressBookEntries(self):
+        entries = [{"label": _b64(label), "address": address}
+                   for label, address in self.node.store.addressbook()]
+        return json.dumps({"addresses": entries}, indent=4)
+
+    def cmd_addAddressBookEntry(self, address, label):
+        decode_address(address)
+        if not self.node.store.addressbook_add(address, _from_b64(label, 17)):
+            raise APIError(16, "Already have this address in the book")
+        return "Added address %s to address book" % address
+
+    def cmd_deleteAddressBookEntry(self, address):
+        decode_address(address)
+        self.node.store.addressbook_delete(address)
+        return "Deleted address book entry for %s" % address
+
+    # -- inbox / sent --------------------------------------------------------
+
+    @staticmethod
+    def _inbox_json(m):
+        return {
+            "msgid": hexlify(m.msgid).decode(),
+            "toAddress": m.toaddress, "fromAddress": m.fromaddress,
+            "subject": _b64(m.subject), "message": _b64(m.message),
+            "encodingType": m.encodingtype, "receivedTime": m.received,
+            "read": int(m.read)}
+
+    @staticmethod
+    def _sent_json(m):
+        return {
+            "msgid": hexlify(m.msgid).decode(),
+            "toAddress": m.toaddress, "fromAddress": m.fromaddress,
+            "subject": _b64(m.subject), "message": _b64(m.message),
+            "encodingType": m.encodingtype,
+            "lastActionTime": m.lastactiontime, "status": m.status,
+            "ackData": hexlify(m.ackdata).decode()}
+
+    def cmd_getAllInboxMessages(self):
+        msgs = [self._inbox_json(m) for m in self.node.store.inbox()]
+        return json.dumps({"inboxMessages": msgs}, indent=4)
+
+    def cmd_getAllInboxMessageIds(self):
+        msgs = [{"msgid": hexlify(m.msgid).decode()}
+                for m in self.node.store.inbox()]
+        return json.dumps({"inboxMessageIds": msgs}, indent=4)
+
+    def cmd_getInboxMessageById(self, msgid_hex, read_flag=None):
+        msgid = self._hex_msgid(msgid_hex)
+        m = self.node.store.inbox_by_id(msgid)
+        if m is None:
+            return json.dumps({"inboxMessage": []})
+        if read_flag is not None:
+            self.node.store.mark_read(msgid, bool(read_flag))
+        return json.dumps({"inboxMessage": [self._inbox_json(m)]}, indent=4)
+
+    def cmd_getInboxMessagesByReceiver(self, toAddress):
+        msgs = [self._inbox_json(m) for m in self.node.store.inbox()
+                if m.toaddress == toAddress]
+        return json.dumps({"inboxMessages": msgs}, indent=4)
+
+    def cmd_getAllSentMessages(self):
+        msgs = [self._sent_json(m) for m in self.node.store.all_sent()]
+        return json.dumps({"sentMessages": msgs}, indent=4)
+
+    def cmd_getAllSentMessageIds(self):
+        msgs = [{"msgid": hexlify(m.msgid).decode()}
+                for m in self.node.store.all_sent()]
+        return json.dumps({"sentMessageIds": msgs}, indent=4)
+
+    def cmd_getSentMessageById(self, msgid_hex):
+        m = self.node.store.sent_by_id(self._hex_msgid(msgid_hex))
+        if m is None:
+            return json.dumps({"sentMessage": []})
+        return json.dumps({"sentMessage": [self._sent_json(m)]}, indent=4)
+
+    def cmd_getSentMessagesByAddress(self, fromAddress):
+        msgs = [self._sent_json(m) for m in self.node.store.all_sent()
+                if m.fromaddress == fromAddress]
+        return json.dumps({"sentMessages": msgs}, indent=4)
+
+    def cmd_getSentMessageByAckData(self, ackdata_hex):
+        ack = unhexlify(ackdata_hex)
+        m = self.node.store.sent_by_ackdata(ack)
+        if m is None:
+            return json.dumps({"sentMessage": []})
+        return json.dumps({"sentMessage": [self._sent_json(m)]}, indent=4)
+
+    def cmd_trashMessage(self, msgid_hex):
+        msgid = self._hex_msgid(msgid_hex)
+        self.node.store.trash_inbox(msgid)
+        self.node.store.trash_sent(msgid)
+        return "Trashed message (assuming message existed)."
+
+    def cmd_trashInboxMessage(self, msgid_hex):
+        self.node.store.trash_inbox(self._hex_msgid(msgid_hex))
+        return "Trashed inbox message (assuming message existed)."
+
+    def cmd_trashSentMessage(self, msgid_hex):
+        self.node.store.trash_sent(self._hex_msgid(msgid_hex))
+        return "Trashed sent message (assuming message existed)."
+
+    def cmd_trashSentMessageByAckData(self, ackdata_hex):
+        self.node.store.trash_sent_by_ackdata(unhexlify(ackdata_hex))
+        return "Trashed sent message (assuming message existed)."
+
+    @staticmethod
+    def _hex_msgid(msgid_hex) -> bytes:
+        try:
+            return unhexlify(msgid_hex)
+        except Exception as exc:
+            raise APIError(22, str(exc))
+
+    # -- sending -------------------------------------------------------------
+
+    async def cmd_sendMessage(self, toAddress, fromAddress, subject,
+                              message, encodingType=2, TTL=4 * 24 * 3600):
+        if encodingType not in (2, 3):
+            raise APIError(6)
+        subject = _from_b64(subject)
+        message = _from_b64(message)
+        if len(message) > 2**18:
+            raise APIError(27)
+        decode_address(toAddress)
+        ident = self.node.keystore.get(fromAddress)
+        if ident is None:
+            raise APIError(13)
+        if not ident.enabled:
+            raise APIError(14)
+        TTL = max(60 * 60, min(int(TTL), 28 * 24 * 3600))
+        ack = await self.node.send_message(
+            toAddress, fromAddress, subject, message,
+            ttl=TTL, encoding=encodingType)
+        return hexlify(ack).decode()
+
+    async def cmd_sendBroadcast(self, fromAddress, subject, message,
+                                encodingType=2, TTL=4 * 24 * 3600):
+        if encodingType not in (2, 3):
+            raise APIError(6)
+        subject = _from_b64(subject)
+        message = _from_b64(message)
+        if len(message) > 2**18:
+            raise APIError(27)
+        ident = self.node.keystore.get(fromAddress)
+        if ident is None:
+            raise APIError(13)
+        TTL = max(60 * 60, min(int(TTL), 28 * 24 * 3600))
+        ack = await self.node.send_broadcast(
+            fromAddress, subject, message, ttl=TTL, encoding=encodingType)
+        return hexlify(ack).decode()
+
+    def cmd_getStatus(self, ackdata_hex):
+        if len(ackdata_hex) not in range(64, 200):
+            raise APIError(15)
+        return self.node.message_status(unhexlify(ackdata_hex))
+
+    # -- subscriptions -------------------------------------------------------
+
+    def cmd_addSubscription(self, address, label=""):
+        decode_address(address)
+        if address in self.node.keystore.subscriptions:
+            raise APIError(16)
+        self.node.keystore.subscribe(address, _from_b64(label, 17)
+                                     if label else "")
+        return "Added subscription."
+
+    def cmd_deleteSubscription(self, address):
+        self.node.keystore.unsubscribe(address)
+        return "Deleted subscription if it existed."
+
+    def cmd_listSubscriptions(self):
+        subs = [{"label": _b64(s.label), "address": s.address,
+                 "enabled": s.enabled}
+                for s in self.node.keystore.subscriptions.values()]
+        return json.dumps({"subscriptions": subs}, indent=4)
+
+    # -- raw dissemination ---------------------------------------------------
+
+    def cmd_disseminatePreEncryptedMsg(self, payload_hex, *_ignored):
+        """Accept a fully-formed, pre-PoW'd msg object and flood it
+        (api.py:1275-1340)."""
+        payload = unhexlify(payload_hex)
+        return self._disseminate(payload, OBJECT_MSG)
+
+    def cmd_disseminatePubkey(self, payload_hex):
+        payload = unhexlify(payload_hex)
+        return self._disseminate(payload, OBJECT_PUBKEY)
+
+    def _disseminate(self, payload: bytes, expected_type: int) -> str:
+        hdr = ObjectHeader.parse(payload)
+        if not check_pow(payload, self.node.ctx.pow_ntpb,
+                         self.node.ctx.pow_extra, clamp=False):
+            raise APIError(21, "proof of work insufficient")
+        h = inventory_hash(payload)
+        tag = b""
+        if expected_type == OBJECT_PUBKEY and hdr.version >= 4:
+            tag = payload[hdr.header_length:hdr.header_length + 32]
+        self.node.inventory.add(h, hdr.object_type, hdr.stream, payload,
+                                hdr.expires, tag)
+        self.node.pool.announce_object(h, hdr.stream, local=True)
+        return hexlify(h).decode()
+
+    # -- inventory queries ---------------------------------------------------
+
+    def cmd_getMessageDataByDestinationHash(self, ripe_hex):
+        return self.cmd_getMessageDataByDestinationTag(ripe_hex)
+
+    def cmd_getMessageDataByDestinationTag(self, tag_hex):
+        if len(tag_hex) != 64:
+            raise APIError(19)
+        tag = unhexlify(tag_hex)
+        items = self.node.inventory.by_type_and_tag(OBJECT_MSG, tag)
+        return json.dumps({"receivedMessageDatas": [
+            {"data": hexlify(i.payload).decode()} for i in items]})
+
+    # -- status / admin ------------------------------------------------------
+
+    def cmd_clientStatus(self):
+        pool = self.node.pool
+        established = len(pool.established())
+        status = ("connectedAndReceivingIncomingConnections"
+                  if pool.inbound else
+                  "connectedButHaveNotReceivedIncomingConnections"
+                  if established else "notConnected")
+        return json.dumps({
+            "networkConnections": established,
+            "numberOfNetworkConnections": established,
+            "networkStatus": status,
+            "numberOfMessagesProcessed":
+                self.node.processor.messages_processed,
+            "numberOfBroadcastsProcessed":
+                self.node.processor.broadcasts_processed,
+            "numberOfPubkeysProcessed":
+                self.node.processor.pubkeys_processed,
+            "pendingDownload": self.node.ctx.global_tracker.pending_count(),
+            "softwareName": "pybitmessage-tpu",
+            "softwareVersion": "0.1.0",
+            "powBackends": getattr(self.node.solver, "backends",
+                                   lambda: ["custom"])(),
+        }, indent=4)
+
+    def cmd_deleteAndVacuum(self):
+        self.node.db.execute("DELETE FROM inbox WHERE folder='trash'")
+        self.node.db.execute("DELETE FROM sent WHERE folder='trash'")
+        self.node.db.vacuum()
+        return "done"
+
+    async def cmd_shutdown(self):
+        asyncio.get_running_loop().call_soon(
+            lambda: asyncio.ensure_future(self.node.stop()))
+        return "done"

@@ -1,0 +1,129 @@
+"""Minimal asyncio HTTP JSON-RPC server with basic auth.
+
+Reference: src/api.py singleAPI — XML/JSON-RPC on 127.0.0.1:8442 with
+HTTP basic auth (api.py:437-457) and port retry.  This implementation
+speaks JSON-RPC 2.0 (apivariant=json of the reference); the request is
+``{"method": ..., "params": [...], "id": ...}`` POSTed to ``/``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import base64
+import json
+import logging
+
+from .commands import APIError, CommandHandler
+
+logger = logging.getLogger("pybitmessage_tpu.api")
+
+MAX_REQUEST = 32 * 1024 * 1024
+
+
+class APIServer:
+    def __init__(self, node, *, host: str = "127.0.0.1", port: int = 8442,
+                 username: str = "", password: str = ""):
+        self.node = node
+        self.host = host
+        self.port = port
+        self.username = username
+        self.password = password
+        self.handler = CommandHandler(node)
+        self._server: asyncio.AbstractServer | None = None
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(
+            self._handle, self.host, self.port)
+
+    @property
+    def listen_port(self) -> int:
+        if self._server and self._server.sockets:
+            return self._server.sockets[0].getsockname()[1]
+        return self.port
+
+    async def stop(self) -> None:
+        if self._server:
+            self._server.close()
+            await self._server.wait_closed()
+
+    # -- request handling ----------------------------------------------------
+
+    def _authorized(self, headers: dict[str, str]) -> bool:
+        if not self.username and not self.password:
+            return True
+        auth = headers.get("authorization", "")
+        if not auth.lower().startswith("basic "):
+            return False
+        try:
+            user, _, pwd = base64.b64decode(
+                auth.split(None, 1)[1]).decode("utf-8").partition(":")
+        except Exception:
+            return False
+        return user == self.username and pwd == self.password
+
+    async def _handle(self, reader: asyncio.StreamReader,
+                      writer: asyncio.StreamWriter) -> None:
+        try:
+            request_line = await reader.readline()
+            headers: dict[str, str] = {}
+            while True:
+                line = await reader.readline()
+                if line in (b"\r\n", b"\n", b""):
+                    break
+                k, _, v = line.decode("latin-1").partition(":")
+                headers[k.strip().lower()] = v.strip()
+            length = int(headers.get("content-length", 0))
+            if length > MAX_REQUEST:
+                await self._respond(writer, 413, {"error": "too large"})
+                return
+            body = await reader.readexactly(length) if length else b""
+
+            if not request_line.startswith(b"POST"):
+                await self._respond(writer, 405,
+                                    {"error": "POST JSON-RPC only"})
+                return
+            if not self._authorized(headers):
+                await self._respond(writer, 401, {"error": "unauthorized"},
+                                    extra="WWW-Authenticate: Basic\r\n")
+                return
+            try:
+                req = json.loads(body)
+            except Exception:
+                await self._respond(writer, 400, {"error": "bad json"})
+                return
+            response = await self._dispatch(req)
+            await self._respond(writer, 200, response)
+        except (asyncio.IncompleteReadError, ConnectionError, OSError):
+            pass
+        except Exception:
+            logger.exception("API request failed")
+        finally:
+            try:
+                writer.close()
+                await writer.wait_closed()
+            except Exception:
+                pass
+
+    async def _dispatch(self, req: dict) -> dict:
+        method = req.get("method", "")
+        params = req.get("params", [])
+        rid = req.get("id")
+        try:
+            result = await self.handler.dispatch(method, list(params))
+            return {"jsonrpc": "2.0", "result": result, "id": rid}
+        except APIError as exc:
+            return {"jsonrpc": "2.0", "id": rid,
+                    "error": {"code": exc.code, "message": str(exc)}}
+
+    @staticmethod
+    async def _respond(writer, status: int, payload: dict,
+                       extra: str = "") -> None:
+        body = json.dumps(payload).encode("utf-8")
+        reason = {200: "OK", 400: "Bad Request", 401: "Unauthorized",
+                  405: "Method Not Allowed", 413: "Payload Too Large"}
+        head = (f"HTTP/1.1 {status} {reason.get(status, '')}\r\n"
+                f"Content-Type: application/json\r\n"
+                f"Content-Length: {len(body)}\r\n"
+                f"{extra}Connection: close\r\n\r\n")
+        writer.write(head.encode("latin-1") + body)
+        await writer.drain()

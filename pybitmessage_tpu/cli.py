@@ -1,0 +1,150 @@
+"""API client CLI (role of the reference's bitmessagecli.py).
+
+Drives a running daemon's JSON-RPC API:
+
+    python -m pybitmessage_tpu.cli --api-port 8442 listaddresses
+    python -m pybitmessage_tpu.cli createaddress --label work
+    python -m pybitmessage_tpu.cli send BM-to BM-from "subject" "body"
+    python -m pybitmessage_tpu.cli inbox
+    python -m pybitmessage_tpu.cli status <ackdata-hex>
+"""
+
+from __future__ import annotations
+
+import argparse
+import base64
+import http.client
+import json
+import sys
+
+
+class RPCClient:
+    def __init__(self, host="127.0.0.1", port=8442, user="", password=""):
+        self.host, self.port = host, port
+        self.auth = base64.b64encode(
+            f"{user}:{password}".encode()).decode() if (user or password) \
+            else None
+
+    def call(self, method, *params):
+        conn = http.client.HTTPConnection(self.host, self.port, timeout=120)
+        headers = {"Content-Type": "application/json"}
+        if self.auth:
+            headers["Authorization"] = "Basic " + self.auth
+        try:
+            conn.request("POST", "/", json.dumps(
+                {"method": method, "params": list(params), "id": 1}),
+                headers)
+            http_resp = conn.getresponse()
+            if http_resp.status == 401:
+                raise SystemExit("error: API authentication failed "
+                                 "(check --api-user/--api-password)")
+            resp = json.loads(http_resp.read())
+        except (ConnectionError, OSError) as exc:
+            raise SystemExit(
+                f"error: cannot reach API at {self.host}:{self.port} "
+                f"({exc})")
+        finally:
+            conn.close()
+        if "error" in resp and resp["error"]:
+            raise SystemExit(f"error: {resp['error']['message']}")
+        return resp["result"]
+
+
+def _b64(s: str) -> str:
+    return base64.b64encode(s.encode()).decode()
+
+
+def _unb64(s: str) -> str:
+    return base64.b64decode(s).decode("utf-8", "replace")
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="pybitmessage_tpu.cli")
+    p.add_argument("--api-host", default="127.0.0.1")
+    p.add_argument("--api-port", type=int, default=8442)
+    p.add_argument("--api-user", default="")
+    p.add_argument("--api-password", default="")
+    sub = p.add_subparsers(dest="command", required=True)
+
+    sub.add_parser("listaddresses")
+    ca = sub.add_parser("createaddress")
+    ca.add_argument("--label", default="")
+    ca.add_argument("--passphrase", default=None,
+                    help="deterministic address from passphrase")
+    send = sub.add_parser("send")
+    send.add_argument("to")
+    send.add_argument("sender")
+    send.add_argument("subject")
+    send.add_argument("body")
+    bc = sub.add_parser("broadcast")
+    bc.add_argument("sender")
+    bc.add_argument("subject")
+    bc.add_argument("body")
+    sub.add_parser("inbox")
+    read = sub.add_parser("read")
+    read.add_argument("msgid")
+    st = sub.add_parser("status")
+    st.add_argument("ackdata")
+    subsc = sub.add_parser("subscribe")
+    subsc.add_argument("address")
+    subsc.add_argument("--label", default="")
+    sub.add_parser("subscriptions")
+    sub.add_parser("clientstatus")
+    trash = sub.add_parser("trash")
+    trash.add_argument("msgid")
+
+    args = p.parse_args(argv)
+    rpc = RPCClient(args.api_host, args.api_port, args.api_user,
+                    args.api_password)
+
+    if args.command == "listaddresses":
+        for a in json.loads(rpc.call("listAddresses"))["addresses"]:
+            print(f"{a['address']}  [{a['label']}]"
+                  + ("  (chan)" if a.get("chan") else ""))
+    elif args.command == "createaddress":
+        if args.passphrase is not None:
+            out = rpc.call("createDeterministicAddresses",
+                           _b64(args.passphrase), 1)
+            print(json.loads(out)["addresses"][0])
+        else:
+            print(rpc.call("createRandomAddress", _b64(args.label)))
+    elif args.command == "send":
+        ack = rpc.call("sendMessage", args.to, args.sender,
+                       _b64(args.subject), _b64(args.body))
+        print(f"queued; ackdata = {ack}")
+    elif args.command == "broadcast":
+        ack = rpc.call("sendBroadcast", args.sender, _b64(args.subject),
+                       _b64(args.body))
+        print(f"queued; ackdata = {ack}")
+    elif args.command == "inbox":
+        msgs = json.loads(rpc.call("getAllInboxMessages"))["inboxMessages"]
+        if not msgs:
+            print("(inbox empty)")
+        for m in msgs:
+            # full msgid so it can be passed straight to `read`/`trash`
+            print(f"{m['msgid']}  {m['fromAddress']} -> "
+                  f"{m['toAddress']}  {_unb64(m['subject'])!r}")
+    elif args.command == "read":
+        out = json.loads(rpc.call("getInboxMessageById", args.msgid))
+        for m in out["inboxMessage"]:
+            print(f"From:    {m['fromAddress']}")
+            print(f"To:      {m['toAddress']}")
+            print(f"Subject: {_unb64(m['subject'])}")
+            print()
+            print(_unb64(m["message"]))
+    elif args.command == "status":
+        print(rpc.call("getStatus", args.ackdata))
+    elif args.command == "subscribe":
+        print(rpc.call("addSubscription", args.address, _b64(args.label)))
+    elif args.command == "subscriptions":
+        for s in json.loads(rpc.call("listSubscriptions"))["subscriptions"]:
+            print(f"{s['address']}  [{_unb64(s['label'])}]")
+    elif args.command == "clientstatus":
+        print(rpc.call("clientStatus"))
+    elif args.command == "trash":
+        print(rpc.call("trashMessage", args.msgid))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -17,7 +17,7 @@ from pathlib import Path
 from ..models.payloads import gen_ack_payload
 from ..network.dandelion import Dandelion
 from ..network.pool import ConnectionPool, NodeContext
-from ..ops import solve as tpu_solve
+from ..pow import PowDispatcher
 from ..storage import Database, Inventory, KnownNodes
 from ..storage.messages import MessageStore
 from ..utils.addresses import decode_address
@@ -67,7 +67,8 @@ class Node:
             pow_ntpb=min_ntpb, pow_extra=min_extra)
         self.pool = ConnectionPool(self.ctx)
         self.listen = listen
-        self.solver = solver or tpu_solve
+        #: solver ladder: TPU -> C++ -> python (proofofwork.run analog)
+        self.solver = solver or PowDispatcher()
 
         self.sender = SendWorker(
             keystore=self.keystore, store=self.store,

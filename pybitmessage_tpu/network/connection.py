@@ -280,7 +280,10 @@ class BMConnection:
         served = 0
         while self.pending_upload and served < limit:
             h = self.pending_upload.popleft()
-            if dand is not None and dand.in_stem_phase(h):
+            if dand is not None and dand.in_stem_phase(h) and \
+                    dand.child_for(h) is not self:
+                # withhold stem objects from everyone EXCEPT the
+                # designated stem child, or the stem could never relay
                 continue
             try:
                 item = self.ctx.inventory[h]

@@ -1,0 +1,11 @@
+"""The PoW solver ladder: TPU -> C++ -> pure Python.
+
+Reference: src/proofofwork.py:288-325 — ``run()`` tries GPU, then the C
+extension, then a multiprocessing pool, then a plain Python loop,
+falling through on any failure, all interruptible via the shutdown
+flag.  Here the accelerator tier is the JAX/Pallas TPU search and the
+native tier is a self-built C++ pthread solver.
+"""
+
+from .dispatcher import PowDispatcher, python_solve  # noqa: F401
+from .native import NativeSolver  # noqa: F401

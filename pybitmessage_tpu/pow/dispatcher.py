@@ -1,0 +1,102 @@
+"""Solver ladder: TPU -> C++ -> pure Python, with fallthrough.
+
+Reference semantics (proofofwork.py:288-325): try the fastest backend;
+on failure log and fall through to the next; every tier is
+interruptible; the winning nonce is host-verified before being trusted
+(the TPU tier already re-checks internally, ops/pow_search.py).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+import time
+from typing import Callable
+
+from ..ops.pow_search import PowInterrupted
+from .native import NativeSolver
+
+logger = logging.getLogger("pybitmessage_tpu.pow")
+
+
+def python_solve(initial_hash: bytes, target: int, *,
+                 start_nonce: int = 0,
+                 should_stop: Callable[[], bool] | None = None):
+    """The always-works tier (reference _doSafePoW, proofofwork.py:157-171)."""
+    nonce = start_nonce
+    trials = 0
+    sha512 = hashlib.sha512
+    while True:
+        if should_stop is not None and trials % 4096 == 0 and should_stop():
+            raise PowInterrupted("python PoW interrupted")
+        value = int.from_bytes(sha512(sha512(
+            nonce.to_bytes(8, "big") + initial_hash).digest()
+        ).digest()[:8], "big")
+        trials += 1
+        if value <= target:
+            return nonce, trials
+        nonce += 1
+
+
+class PowDispatcher:
+    """Callable solver with the GPU->C->python fallback ladder."""
+
+    def __init__(self, *, use_tpu: bool = True, use_native: bool = True,
+                 tpu_kwargs: dict | None = None, num_threads: int = 0):
+        self.tpu_kwargs = tpu_kwargs or {}
+        self._tpu_enabled = use_tpu
+        self._native = NativeSolver(num_threads) if use_native else None
+        self.last_backend = ""
+        self.last_rate = 0.0
+
+    def backends(self) -> list[str]:
+        out = []
+        if self._tpu_enabled:
+            out.append("tpu")
+        if self._native is not None and self._native.available:
+            out.append("cpp")
+        out.append("python")
+        return out
+
+    def __call__(self, initial_hash: bytes, target: int, *,
+                 start_nonce: int = 0,
+                 should_stop: Callable[[], bool] | None = None):
+        t0 = time.monotonic()
+        nonce, trials = self._solve(
+            initial_hash, target, start_nonce, should_stop)
+        dt = max(time.monotonic() - t0, 1e-9)
+        self.last_rate = trials / dt
+        return nonce, trials
+
+    # keep the explicit name too
+    solve = __call__
+
+    def _solve(self, initial_hash, target, start_nonce, should_stop):
+        if self._tpu_enabled:
+            try:
+                from ..ops.pow_search import solve as tpu_solve
+                self.last_backend = "tpu"
+                return tpu_solve(initial_hash, target,
+                                 start_nonce=start_nonce,
+                                 should_stop=should_stop,
+                                 **self.tpu_kwargs)
+            except PowInterrupted:
+                raise
+            except Exception:
+                logger.exception(
+                    "TPU PoW failed; falling through to C++ "
+                    "(reference resetPoW semantics)")
+                self._tpu_enabled = False
+        if self._native is not None and self._native.available:
+            try:
+                self.last_backend = "cpp"
+                return self._native.solve(initial_hash, target,
+                                          start_nonce=start_nonce,
+                                          should_stop=should_stop)
+            except PowInterrupted:
+                raise
+            except Exception:
+                logger.exception("C++ PoW failed; falling through to python")
+        self.last_backend = "python"
+        return python_solve(initial_hash, target, start_nonce=start_nonce,
+                            should_stop=should_stop)

@@ -1,0 +1,107 @@
+"""ctypes binding for the C++ pthread solver (native/pow/bitmsgpow.cpp).
+
+Mirrors the reference's ctypes load + self-test + auto-``make`` flow
+(proofofwork.py:336-394): if the shared object is missing, build it with
+make; verify a known trial value before trusting it.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import logging
+import subprocess
+import threading
+from pathlib import Path
+from typing import Callable
+
+logger = logging.getLogger("pybitmessage_tpu.pow")
+
+_NATIVE_DIR = Path(__file__).resolve().parent.parent.parent / "native" / "pow"
+_LIB = _NATIVE_DIR / "libbitmsgpow.so"
+UINT64_MAX = 2**64 - 1
+
+
+class NativeSolver:
+    """C++ multithreaded double-SHA512 nonce search."""
+
+    def __init__(self, num_threads: int = 0):
+        self.num_threads = num_threads
+        self._lib = self._load()
+
+    @staticmethod
+    def _build() -> bool:
+        try:
+            subprocess.run(["make"], cwd=_NATIVE_DIR, check=True,
+                           capture_output=True, timeout=120)
+            return True
+        except Exception as exc:
+            logger.warning("could not build native solver: %r", exc)
+            return False
+
+    def _load(self):
+        if not _LIB.exists() and not self._build():
+            return None
+        try:
+            lib = ctypes.CDLL(str(_LIB))
+            lib.tpu_bm_pow_solve.restype = ctypes.c_uint64
+            lib.tpu_bm_pow_solve.argtypes = [
+                ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint64,
+                ctypes.c_int, ctypes.POINTER(ctypes.c_int),
+                ctypes.POINTER(ctypes.c_uint64)]
+            lib.tpu_bm_pow_trial.restype = ctypes.c_uint64
+            lib.tpu_bm_pow_trial.argtypes = [ctypes.c_char_p,
+                                             ctypes.c_uint64]
+            if not self._self_test(lib):
+                logger.error("native solver failed self-test; disabled")
+                return None
+            return lib
+        except OSError as exc:
+            logger.warning("could not load native solver: %r", exc)
+            return None
+
+    @staticmethod
+    def _self_test(lib) -> bool:
+        """Known-answer check against hashlib (proofofwork.py:354-361)."""
+        ih = hashlib.sha512(b"native self test").digest()
+        expect = int.from_bytes(hashlib.sha512(hashlib.sha512(
+            (12345).to_bytes(8, "big") + ih).digest()).digest()[:8], "big")
+        return lib.tpu_bm_pow_trial(ih, 12345) == expect
+
+    @property
+    def available(self) -> bool:
+        return self._lib is not None
+
+    def solve(self, initial_hash: bytes, target: int, *,
+              start_nonce: int = 0,
+              should_stop: Callable[[], bool] | None = None):
+        """Blocking search; polls ``should_stop`` from a watcher thread.
+
+        Returns (nonce, trials); raises RuntimeError if unavailable and
+        StopIteration-free PowInterrupted semantics via the dispatcher.
+        """
+        if self._lib is None:
+            raise RuntimeError("native solver unavailable")
+        stop_flag = ctypes.c_int(0)
+        trials_out = ctypes.c_uint64(0)
+        watcher_done = threading.Event()
+
+        def watch():
+            while not watcher_done.wait(0.2):
+                if should_stop is not None and should_stop():
+                    stop_flag.value = 1
+                    return
+
+        watcher = threading.Thread(target=watch, daemon=True)
+        watcher.start()
+        try:
+            nonce = self._lib.tpu_bm_pow_solve(
+                initial_hash, target, start_nonce, self.num_threads,
+                ctypes.byref(stop_flag), ctypes.byref(trials_out))
+        finally:
+            watcher_done.set()
+            watcher.join()
+        if nonce == UINT64_MAX:
+            from ..ops.pow_search import PowInterrupted
+            raise PowInterrupted("native PoW interrupted")
+        return nonce, int(trials_out.value)

@@ -172,6 +172,65 @@ class MessageStore:
         self._db.execute(
             "UPDATE inbox SET folder='trash' WHERE msgid=?", (msgid,))
 
+    def inbox_by_id(self, msgid: bytes) -> InboxMessage | None:
+        rows = self._db.query(
+            "SELECT msgid, toaddress, fromaddress, subject, received,"
+            " message, folder, encodingtype, read, sighash FROM inbox"
+            " WHERE msgid=?", (msgid,))
+        if not rows:
+            return None
+        r = rows[0]
+        return InboxMessage(bytes(r[0]), r[1], r[2], r[3], r[4], r[5],
+                            r[6], r[7], bool(r[8]),
+                            bytes(r[9]) if r[9] is not None else b"")
+
+    def mark_read(self, msgid: bytes, read: bool = True) -> None:
+        self._db.execute("UPDATE inbox SET read=? WHERE msgid=?",
+                         (read, msgid))
+
+    def all_sent(self) -> list[SentMessage]:
+        rows = self._db.query(
+            "SELECT msgid, toaddress, toripe, fromaddress, subject, message,"
+            " ackdata, senttime, lastactiontime, sleeptill, status,"
+            " retrynumber, folder, encodingtype, ttl FROM sent"
+            " WHERE folder='sent'")
+        return [self._sent_row(r) for r in rows]
+
+    def sent_by_id(self, msgid: bytes) -> SentMessage | None:
+        rows = self._db.query(
+            "SELECT msgid, toaddress, toripe, fromaddress, subject, message,"
+            " ackdata, senttime, lastactiontime, sleeptill, status,"
+            " retrynumber, folder, encodingtype, ttl FROM sent"
+            " WHERE msgid=?", (msgid,))
+        return self._sent_row(rows[0]) if rows else None
+
+    def trash_sent(self, msgid: bytes) -> None:
+        self._db.execute(
+            "UPDATE sent SET folder='trash' WHERE msgid=?", (msgid,))
+
+    def trash_sent_by_ackdata(self, ackdata: bytes) -> None:
+        self._db.execute(
+            "UPDATE sent SET folder='trash' WHERE ackdata=?", (ackdata,))
+
+    # -- addressbook ---------------------------------------------------------
+
+    def addressbook(self) -> list[tuple[str, str]]:
+        return [(r[0], r[1]) for r in self._db.query(
+            "SELECT label, address FROM addressbook")]
+
+    def addressbook_add(self, address: str, label: str) -> bool:
+        exists = self._db.query(
+            "SELECT COUNT(*) FROM addressbook WHERE address=?", (address,))
+        if exists[0][0]:
+            return False
+        self._db.execute("INSERT INTO addressbook VALUES (?,?)",
+                         (label, address))
+        return True
+
+    def addressbook_delete(self, address: str) -> None:
+        self._db.execute("DELETE FROM addressbook WHERE address=?",
+                         (address,))
+
     # -- pubkeys -------------------------------------------------------------
 
     def store_pubkey(self, address: str, version: int, payload: bytes,

@@ -179,9 +179,16 @@ class KeyStore:
             if not section.startswith("BM-"):
                 if section == "subscriptions":
                     for addr, label in cfg[section].items():
+                        # populate directly — subscribe() would save()
+                        # mid-load and could rewrite keys.dat before all
+                        # identities are read back
                         try:
-                            self.subscribe(addr if addr.startswith("BM-")
-                                           else "BM-" + addr, label)
+                            full = addr if addr.startswith("BM-") \
+                                else "BM-" + addr
+                            a = decode_address(full)
+                            self.subscriptions[full] = Subscription(
+                                label, full, True, a.version, a.stream,
+                                a.ripe)
                         except Exception:
                             continue
                 continue

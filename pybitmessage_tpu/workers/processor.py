@@ -232,7 +232,7 @@ class ObjectProcessor:
             logger.debug("msg signature invalid")
             return
         # demanded-difficulty recheck (objectProcessor.py:615-629)
-        if not self.keystore.get(match.address).chan:
+        if not match.chan:
             req_ntpb = max(match.nonce_trials_per_byte, self.min_ntpb)
             req_extra = max(match.extra_bytes, self.min_extra)
             ttl = max(header.expires - int(time.time()), 300)

@@ -13,12 +13,11 @@ interruptible via the node's shutdown flag.
 from __future__ import annotations
 
 import asyncio
-import hashlib
 import logging
 import random
 import struct
 import time
-from typing import Awaitable, Callable
+from typing import Callable
 
 from ..crypto import decrypt, encrypt, sign, verify
 from ..crypto.ecies import DecryptionError
@@ -30,14 +29,13 @@ from ..models.constants import (
 from ..models.payloads import (
     MsgPlaintext, BroadcastPlaintext, PayloadError, PubkeyData,
     ack_ttl_bucket, assemble_getpubkey, assemble_pubkey,
-    broadcast_signed_data, double_hash_of_address_data, gen_ack_payload,
-    get_bitfield, bitfield_does_ack, msg_signed_data, object_shell,
-    parse_pubkey_inner,
+    broadcast_signed_data, double_hash_of_address_data, get_bitfield,
+    bitfield_does_ack, object_shell, parse_pubkey_inner,
 )
 from ..models.pow_math import pow_target
 from ..storage.messages import (
-    AWAITINGPUBKEY, BROADCASTSENT, DOINGMSGPOW, MSGQUEUED, MSGSENT,
-    MSGSENTNOACKEXPECTED, MessageStore,
+    ACKRECEIVED, AWAITINGPUBKEY, BROADCASTSENT, DOINGMSGPOW, MSGQUEUED,
+    MSGSENT, MSGSENTNOACKEXPECTED, MessageStore,
 )
 from ..utils.addresses import decode_address
 from ..utils.hashes import inventory_hash, sha512
@@ -220,16 +218,14 @@ class SendWorker:
             dest_ripe=to.ripe, encoding=m.encodingtype or 2,
             message=body, ack_data=ack_packet)
         unsigned = plain.encode_unsigned()
-        # signature covers expires+type+msgver+stream+plaintext-to-ack
+        # msg object shell: expires + type(2) + msgver(1) + stream; the
+        # signature covers shell-sans-nonce + plaintext through ackdata
         # (class_singleWorker.py:1224-1228)
-        signed_data = (struct.pack(">Q", expires) + b"\x00\x00\x00\x02"
-                       + encode_varint(1) + encode_varint(to.stream)
-                       + unsigned)
-        plain.signature = sign(signed_data, sender.priv_signing)
+        shell = object_shell(expires, OBJECT_MSG, 1, to.stream)
+        plain.signature = sign(shell + unsigned, sender.priv_signing)
 
         encrypted = encrypt(plain.encode(), pub_enc)
-        payload = (struct.pack(">Q", expires) + b"\x00\x00\x00\x02"
-                   + encode_varint(1) + encode_varint(to.stream) + encrypted)
+        payload = shell + encrypted
         payload = await self._do_pow(payload, ttl, their_ntpb, their_extra)
         h = self._publish(payload, OBJECT_MSG, to.stream)
         logger.info("msg sent, inventory hash %s", h.hex())
@@ -242,7 +238,7 @@ class SendWorker:
                 msgid=h, toaddress=m.toaddress, fromaddress=m.fromaddress,
                 subject=m.subject, message=m.message,
                 encoding=m.encodingtype or 2, sighash=sighash)
-            self.store.update_sent_status(m.ackdata, ACK_STATUS_SELF)
+            self.store.update_sent_status(m.ackdata, ACKRECEIVED)
         elif ack_packet:
             self.watched_acks.add(m.ackdata)
             self.store.update_sent_status(
@@ -307,15 +303,22 @@ class SendWorker:
                             data.pub_encryption_key) != to.ripe:
                 return None
             return data
-        except (DecryptionError, PayloadError, Exception):
+        except (DecryptionError, PayloadError, ValueError):
+            return None
+        except Exception:
+            logger.exception("unexpected error verifying v4 pubkey object")
             return None
 
     async def _request_pubkey(self, to, toaddress: str,
                               ackdata: bytes) -> None:
         tag = double_hash_of_address_data(to.version, to.stream, to.ripe)[32:]
         if tag in self.needed_pubkeys:
-            self.store.update_sent_status(ackdata, AWAITINGPUBKEY)
-            return  # already requested
+            # already requested: park until the normal retry horizon so
+            # the resend sweep doesn't immediately re-fire it
+            self.store.update_sent_status(
+                ackdata, AWAITINGPUBKEY,
+                sleeptill=int(time.time() + GETPUBKEY_RETRY))
+            return
         self.needed_pubkeys[tag] = toaddress
         ttl = _jitter_ttl(int(GETPUBKEY_RETRY / 2.5))
         expires = int(time.time()) + ttl
@@ -419,8 +422,6 @@ class SendWorker:
                 self.store.update_sent_status(m.ackdata, MSGQUEUED)
             await self.queue.put(("sendmessage",))
 
-
-ACK_STATUS_SELF = "ackreceived"  # self/chan sends complete immediately
 
 
 def _pubkey_inner_bytes(data: PubkeyData) -> bytes:

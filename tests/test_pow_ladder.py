@@ -1,0 +1,73 @@
+"""Solver-ladder tests: native C++ solver, python fallback, dispatcher."""
+
+import hashlib
+import threading
+
+import pytest
+
+from pybitmessage_tpu.ops.pow_search import PowInterrupted
+from pybitmessage_tpu.pow import NativeSolver, PowDispatcher, python_solve
+
+
+def _host_trial(nonce, ih):
+    return int.from_bytes(hashlib.sha512(hashlib.sha512(
+        nonce.to_bytes(8, "big") + ih).digest()).digest()[:8], "big")
+
+
+IH = hashlib.sha512(b"ladder test").digest()
+EASY = 2**59
+
+
+def test_native_solver_builds_and_solves():
+    solver = NativeSolver(num_threads=2)
+    assert solver.available, "C++ solver must build and self-test"
+    nonce, trials = solver.solve(IH, EASY)
+    assert _host_trial(nonce, IH) <= EASY
+    assert trials > 0
+
+
+def test_native_solver_interruptible():
+    solver = NativeSolver(num_threads=2)
+    stop = threading.Event()
+    threading.Timer(0.3, stop.set).start()
+    with pytest.raises(PowInterrupted):
+        solver.solve(IH, 0, should_stop=stop.is_set)  # impossible target
+
+
+def test_python_solver():
+    nonce, trials = python_solve(IH, 2**58)
+    assert _host_trial(nonce, IH) <= 2**58
+
+
+def test_python_solver_interruptible():
+    calls = []
+
+    def stop():
+        calls.append(1)
+        return len(calls) > 2
+
+    with pytest.raises(PowInterrupted):
+        python_solve(IH, 0, should_stop=stop)
+
+
+def test_dispatcher_ladder_order_and_fallthrough():
+    d = PowDispatcher(use_tpu=False)
+    assert d.backends()[0] == "cpp"
+    nonce, _ = d(IH, EASY)
+    assert _host_trial(nonce, IH) <= EASY
+    assert d.last_backend == "cpp"
+    assert d.last_rate > 0
+
+    # break the native tier; ladder must fall through to python
+    d._native._lib = None
+    nonce, _ = d(IH, EASY)
+    assert d.last_backend == "python"
+    assert _host_trial(nonce, IH) <= EASY
+
+
+def test_dispatcher_tpu_tier():
+    d = PowDispatcher(use_tpu=True,
+                      tpu_kwargs={"lanes": 1024, "chunks_per_call": 8})
+    nonce, _ = d(IH, EASY)
+    assert d.last_backend == "tpu"
+    assert _host_trial(nonce, IH) <= EASY
