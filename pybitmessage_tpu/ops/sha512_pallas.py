@@ -1,0 +1,184 @@
+"""Pallas TPU kernel: VMEM-resident double-SHA512 nonce search.
+
+Differences from the XLA path (pow_search.py): the entire search slab
+runs inside ONE kernel — the round state (24 uint32 tile pairs) lives
+in VMEM/registers across all 160 rounds and all grid steps, instead of
+being materialized to HBM at every fori_loop iteration boundary.  A
+SMEM "found" flag carried across the sequential grid gives early exit:
+once a block hits, later blocks skip their compute.
+
+Layout: grid = (chunks,); each grid step evaluates a (ROWS, 128) tile
+of nonces = base + step*ROWS*128 + lane.  Outputs per step: hit flag
+and winning (nonce_hi, nonce_lo); the host takes the first hit.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .sha512_jax import _H0, _K
+from .u64 import U32
+
+LANE_COLS = 128
+
+
+def _pair(value: int):
+    return jnp.uint32(value >> 32), jnp.uint32(value & 0xFFFFFFFF)
+
+
+def _rotr(x, n):
+    hi, lo = x
+    if n == 32:
+        return lo, hi
+    if n < 32:
+        m = 32 - n
+        return (hi >> n) | (lo << m), (lo >> n) | (hi << m)
+    n -= 32
+    m = 32 - n
+    return (lo >> n) | (hi << m), (hi >> n) | (lo << m)
+
+
+def _shr(x, n):
+    hi, lo = x
+    if n >= 32:
+        return jnp.zeros_like(hi), hi >> (n - 32)
+    return hi >> n, (lo >> n) | (hi << (32 - n))
+
+
+def _xor3(a, b, c):
+    return a[0] ^ b[0] ^ c[0], a[1] ^ b[1] ^ c[1]
+
+
+def _add(a, b):
+    lo = a[1] + b[1]
+    carry = (lo < a[1]).astype(U32)
+    return a[0] + b[0] + carry, lo
+
+
+def _add_many(*terms):
+    acc = terms[0]
+    for t in terms[1:]:
+        acc = _add(acc, t)
+    return acc
+
+
+def _compress(w):
+    """80 rounds over a 16-entry python-list window of tile pairs."""
+    a, b, c, d, e, f, g, h = [_broadcast_pair(_pair(x), w[0][0].shape)
+                              for x in _H0]
+    for t in range(80):
+        if t < 16:
+            wt = w[t]
+        else:
+            wt = _add_many(
+                _xor3(_rotr(w[(t - 2) % 16], 19), _rotr(w[(t - 2) % 16], 61),
+                      _shr(w[(t - 2) % 16], 6)),
+                w[(t - 7) % 16],
+                _xor3(_rotr(w[(t - 15) % 16], 1), _rotr(w[(t - 15) % 16], 8),
+                      _shr(w[(t - 15) % 16], 7)),
+                w[t % 16])
+            w[t % 16] = wt
+        ch = ((e[0] & f[0]) ^ (~e[0] & g[0]),
+              (e[1] & f[1]) ^ (~e[1] & g[1]))
+        maj = ((a[0] & b[0]) ^ (a[0] & c[0]) ^ (b[0] & c[0]),
+               (a[1] & b[1]) ^ (a[1] & c[1]) ^ (b[1] & c[1]))
+        s1e = _xor3(_rotr(e, 14), _rotr(e, 18), _rotr(e, 41))
+        s0a = _xor3(_rotr(a, 28), _rotr(a, 34), _rotr(a, 39))
+        t1 = _add_many(h, s1e, ch, _pair(_K[t]), wt)
+        t2 = _add(s0a, maj)
+        h, g, f, e = g, f, e, _add(d, t1)
+        d, c, b, a = c, b, a, _add(t1, t2)
+    return [_add(_broadcast_pair(_pair(_H0[i]), a[0].shape), v)
+            for i, v in enumerate([a, b, c, d, e, f, g, h])]
+
+
+def _broadcast_pair(pair, shape):
+    return (jnp.broadcast_to(pair[0], shape), jnp.broadcast_to(pair[1], shape))
+
+
+def _kernel(ih_ref, base_ref, target_ref, found_ref, nonce_ref, *,
+            rows: int):
+    step = pl.program_id(0)
+    shape = (rows, LANE_COLS)
+
+    def do_search():
+        lane = (jax.lax.broadcasted_iota(U32, shape, 0)
+                * jnp.uint32(LANE_COLS)
+                + jax.lax.broadcasted_iota(U32, shape, 1))
+        offset = jnp.uint32(step) * jnp.uint32(rows * LANE_COLS)
+        base_hi = base_ref[0]
+        base_lo = base_ref[1]
+        lo = base_lo + offset + lane
+        carry = (lo < base_lo).astype(U32)  # offset+lane < 2^32 per slab
+        hi = jnp.broadcast_to(base_hi, shape) + carry
+
+        zero = jnp.zeros(shape, dtype=U32)
+
+        def bcs(x):
+            return jnp.broadcast_to(x, shape)
+
+        w = [(hi, lo)]
+        w += [(bcs(ih_ref[i, 0]), bcs(ih_ref[i, 1])) for i in range(8)]
+        w.append((bcs(jnp.uint32(0x80000000)), zero))
+        w += [(zero, zero)] * 5
+        w.append((zero, bcs(jnp.uint32(576))))
+        h1 = _compress(w)
+
+        w2 = list(h1)
+        w2.append((bcs(jnp.uint32(0x80000000)), zero))
+        w2 += [(zero, zero)] * 6
+        w2.append((zero, bcs(jnp.uint32(512))))
+        h2 = _compress(w2)
+        v_hi, v_lo = h2[0]
+
+        t_hi = target_ref[0]
+        t_lo = target_ref[1]
+        ok = (v_hi < t_hi) | ((v_hi == t_hi) & (v_lo <= t_lo))
+        # winner = smallest lane index with a hit.  Mosaic has no
+        # unsigned reductions; lane < 2^31 so int32 min is safe.
+        big = jnp.int32(0x7FFFFFFF)
+        win_i = jnp.min(jnp.where(ok, lane.astype(jnp.int32), big))
+        hit = win_i != big
+        win = win_i.astype(U32)
+        found_ref[step, 0] = hit.astype(jnp.int32)
+        wl = base_lo + offset + win
+        wc = (wl < base_lo).astype(U32)
+        nonce_ref[step, 0] = base_hi + wc
+        nonce_ref[step, 1] = wl
+
+    do_search()
+
+
+@functools.partial(jax.jit, static_argnames=("rows", "chunks", "interpret"))
+def pallas_search(ih_words, base, target, rows: int = 256,
+                  chunks: int = 16, interpret: bool = False):
+    """Search nonces [base, base + chunks*rows*128) for value <= target.
+
+    ``ih_words``: (8, 2) uint32 — initial-hash words as (hi, lo);
+    ``base``/``target``: (2,) uint32 pairs.  Returns (found (chunks,),
+    nonce (chunks, 2)) per grid step.
+    """
+    grid = (chunks,)
+    kernel = functools.partial(_kernel, rows=rows)
+    found, nonce = pl.pallas_call(
+        kernel,
+        out_shape=(jax.ShapeDtypeStruct((chunks, 1), jnp.int32),
+                   jax.ShapeDtypeStruct((chunks, 2), U32)),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+        ],
+        out_specs=(
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+        ),
+        interpret=interpret,
+    )(ih_words, base, target)
+    return found[:, 0], nonce
