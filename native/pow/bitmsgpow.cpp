@@ -7,7 +7,9 @@
 //
 // Exported C ABI (loaded via ctypes from pybitmessage_tpu/pow/native.py):
 //   tpu_bm_pow_solve(initial_hash[64], target, start_nonce, num_threads,
-//                    stop_flag) -> winning nonce, or UINT64_MAX if stopped.
+//                    stop_flag, trials_out, found_out) -> winning nonce;
+//   *found_out distinguishes "found" from "interrupted" so every u64
+//   value (including 2^64-1) is a representable nonce.
 
 #include <atomic>
 #include <cstdint>
@@ -139,13 +141,14 @@ static void search_thread(int tid, int nthreads, const u64* ih, u64 target,
 
 extern "C" {
 
-// Returns the winning nonce, or UINT64_MAX when interrupted via
-// *stop_flag before any thread found one.  trials_out (optional)
-// receives the total trial count.
+// Returns the winning nonce when *found_out is set to 1; when the
+// search was interrupted via *stop_flag first, *found_out is 0 and the
+// return value is meaningless.  trials_out (optional) receives the
+// total trial count.
 uint64_t tpu_bm_pow_solve(const uint8_t* initial_hash, uint64_t target,
                           uint64_t start_nonce, int num_threads,
                           const volatile int* stop_flag,
-                          uint64_t* trials_out) {
+                          uint64_t* trials_out, int* found_out) {
   if (num_threads <= 0) {
     num_threads = (int)std::thread::hardware_concurrency();
     if (num_threads <= 0) num_threads = 1;
@@ -164,7 +167,9 @@ uint64_t tpu_bm_pow_solve(const uint8_t* initial_hash, uint64_t target,
                          start_nonce, stop_flag, &sh);
   for (auto& th : threads) th.join();
   if (trials_out) *trials_out = sh.trials.load();
-  return sh.found.load() ? sh.winner.load() : UINT64_MAX;
+  int found = sh.found.load();
+  if (found_out) *found_out = found;
+  return found ? sh.winner.load() : 0;
 }
 
 // Single trial value — used by the Python wrapper's self-test.

@@ -1,7 +1,9 @@
 """Daemon entry point: ``python -m pybitmessage_tpu``.
 
 Reference: src/bitmessagemain.py Main.start() — single process, clean
-shutdown on SIGINT/SIGTERM, optional test mode (-t) and trusted peer.
+shutdown on SIGINT/SIGTERM, optional test mode (-t) and trusted peer;
+configuration layered as defaults <- settings.dat <- CLI flags
+(reference bmconfigparser + helper_startup.loadConfig).
 """
 
 from __future__ import annotations
@@ -11,6 +13,7 @@ import asyncio
 import logging
 import signal
 import sys
+from pathlib import Path
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -19,11 +22,11 @@ def build_parser() -> argparse.ArgumentParser:
         description="TPU-native Bitmessage node")
     p.add_argument("-d", "--data-dir", default=None,
                    help="data directory (default: in-memory)")
-    p.add_argument("-p", "--port", type=int, default=8444,
-                   help="P2P listen port")
+    p.add_argument("-p", "--port", type=int, default=None,
+                   help="P2P listen port (default from settings: 8444)")
     p.add_argument("--no-listen", action="store_true",
                    help="outbound connections only")
-    p.add_argument("--api-port", type=int, default=8442)
+    p.add_argument("--api-port", type=int, default=None)
     p.add_argument("--no-api", action="store_true")
     p.add_argument("--api-user", default="")
     p.add_argument("--api-password", default="")
@@ -32,10 +35,43 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--trusted-peer", default=None, metavar="HOST:PORT",
                    help="connect only to this peer")
     p.add_argument("--no-dandelion", action="store_true")
+    p.add_argument("--no-udp", action="store_true",
+                   help="disable UDP LAN discovery")
     p.add_argument("--seed-defaults", action="store_true",
                    help="seed the bootstrap nodes into knownnodes")
+    p.add_argument("--set", action="append", default=[],
+                   metavar="KEY=VALUE", dest="set_options",
+                   help="persist a settings option and continue")
     p.add_argument("-v", "--verbose", action="store_true")
     return p
+
+
+def load_settings(args):
+    """defaults <- settings.dat <- --set <- per-flag CLI overrides."""
+    from .core.config import Settings
+
+    path = Path(args.data_dir) / "settings.dat" if args.data_dir else None
+    settings = Settings(path)
+    for kv in args.set_options:
+        key, _, value = kv.partition("=")
+        settings.set(key.strip(), value.strip())
+    if args.set_options:
+        settings.save()
+    if args.port is not None:
+        settings.set_temp("port", args.port)
+    if args.api_port is not None:
+        settings.set_temp("apiport", args.api_port)
+    if args.api_user:
+        settings.set_temp("apiusername", args.api_user)
+    if args.api_password:
+        settings.set_temp("apipassword", args.api_password)
+    if args.api_user and args.api_password and not args.no_api:
+        settings.set_temp("apienabled", True)
+    if args.no_dandelion:
+        settings.set_temp("dandelion", 0)
+    if args.no_udp:
+        settings.set_temp("udp", False)
+    return settings
 
 
 async def run(args) -> int:
@@ -43,9 +79,29 @@ async def run(args) -> int:
     from .core import Node
     from .storage.knownnodes import Peer
 
-    node = Node(args.data_dir, port=args.port, listen=not args.no_listen,
+    settings = load_settings(args)
+    node = Node(args.data_dir,
+                port=settings.getint("port"),
+                listen=not args.no_listen,
                 test_mode=args.test_mode,
-                dandelion_enabled=not args.no_dandelion)
+                dandelion_enabled=settings.getint("dandelion") > 0,
+                tls_enabled=settings.getbool("tls"),
+                udp_enabled=settings.getbool("udp") and not args.no_listen)
+    node.settings = settings
+    node.dandelion.stem_probability = settings.getint("dandelion")
+    # kB/s global throttles (reference maxdownloadrate/maxuploadrate)
+    node.ctx.download_bucket.rate = settings.getint("maxdownloadrate") * 1024
+    node.ctx.upload_bucket.rate = settings.getint("maxuploadrate") * 1024
+    node.pool.max_outbound = settings.getint("maxoutboundconnections")
+    node.pool.max_total = settings.getint("maxtotalconnections")
+    if settings.get("sockstype") != "none":
+        node.ctx.proxy = {
+            "type": settings.get("sockstype"),
+            "host": settings.get("sockshostname"),
+            "port": settings.getint("socksport"),
+            "username": settings.get("socksusername"),
+            "password": settings.get("sockspassword"),
+        }
     if args.trusted_peer:
         host, _, port = args.trusted_peer.rpartition(":")
         node.pool.trusted_peer = Peer(host, int(port))
@@ -53,13 +109,42 @@ async def run(args) -> int:
         node.knownnodes.seed_defaults()
 
     await node.start()
+
     api = None
-    if not args.no_api:
-        api = APIServer(node, port=args.api_port,
-                        username=args.api_user,
-                        password=args.api_password)
+    # The API is powerful (reads inboxes, sends messages); match the
+    # reference's default-off-with-mandatory-auth posture: refuse to
+    # serve without credentials except in explicit test mode
+    # (reference bmconfigparser 'apienabled' + apiusername/apipassword).
+    want_api = not args.no_api and (settings.getbool("apienabled")
+                                    or args.test_mode)
+    has_creds = settings.get("apiusername") and settings.get("apipassword")
+    if want_api and not has_creds and not args.test_mode:
+        logging.warning(
+            "API disabled: set apiusername/apipassword (or --api-user/"
+            "--api-password, or run with -t for test mode)")
+        want_api = False
+    if want_api:
+        api = APIServer(node, port=settings.getint("apiport"),
+                        username=settings.get("apiusername"),
+                        password=settings.get("apipassword"))
         await api.start()
         logging.info("API listening on 127.0.0.1:%d", api.listen_port)
+
+    smtp_gw = None
+    if settings.getbool("smtpdenabled"):
+        from .gateways import SMTPGateway
+        smtp_gw = SMTPGateway(
+            node, port=settings.getint("smtpdport"),
+            username=settings.get("smtpdusername", ""),
+            password=settings.get("smtpdpassword", ""))
+        await smtp_gw.start()
+        logging.info("SMTP gateway on 127.0.0.1:%d", smtp_gw.listen_port)
+
+    deliverer = None
+    if settings.get("smtpdeliver"):
+        from .gateways import SMTPDeliverer
+        deliverer = SMTPDeliverer(node, settings.get("smtpdeliver"))
+        deliverer.start()
 
     stop = asyncio.Event()
     loop = asyncio.get_running_loop()
@@ -70,9 +155,14 @@ async def run(args) -> int:
             pass
     await stop.wait()
     logging.info("shutting down...")
+    if deliverer is not None:
+        deliverer.stop()
+    if smtp_gw is not None:
+        await smtp_gw.stop()
     if api is not None:
         await api.stop()
     await node.stop()
+    settings.save()
     return 0
 
 

@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import asyncio
 import base64
+import hmac
 import json
 import logging
 
@@ -59,7 +60,11 @@ class APIServer:
                 auth.split(None, 1)[1]).decode("utf-8").partition(":")
         except Exception:
             return False
-        return user == self.username and pwd == self.password
+        # constant-time comparison — don't leak credential prefixes to
+        # local timing observers
+        user_ok = hmac.compare_digest(user.encode(), self.username.encode())
+        pwd_ok = hmac.compare_digest(pwd.encode(), self.password.encode())
+        return user_ok and pwd_ok
 
     async def _handle(self, reader: asyncio.StreamReader,
                       writer: asyncio.StreamWriter) -> None:

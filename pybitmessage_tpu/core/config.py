@@ -1,0 +1,225 @@
+"""Layered persisted settings with defaults, validators and migrations.
+
+Role model: the reference's ``BMConfigParser`` singleton layered over
+``default.ini`` with per-option validators, a non-persisted ``setTemp``
+overlay, timestamped ``.bak`` on save, and a versioned upgrade chain
+(src/bmconfigparser.py:106-158, src/default.ini,
+src/helper_startup.py:39-260).  Differences: no singleton — a
+``Settings`` object is constructed with an explicit path and injected
+into the Node — and key material lives in ``keys.dat``
+(workers/keystore.py), not here.
+"""
+
+from __future__ import annotations
+
+import configparser
+import logging
+import os
+import time
+from pathlib import Path
+from typing import Callable
+
+logger = logging.getLogger("pybitmessage_tpu.config")
+
+SECTION = "bitmessagesettings"
+
+#: current settings schema version — bump with each migration
+SETTINGS_VERSION = 2
+
+#: defaults (reference default.ini + helper_startup first-run defaults)
+DEFAULTS: dict[str, str] = {
+    "settingsversion": str(SETTINGS_VERSION),
+    "port": "8444",
+    "maxoutboundconnections": "8",
+    "maxtotalconnections": "200",
+    "maxdownloadrate": "0",          # kB/s, 0 = unlimited
+    "maxuploadrate": "0",
+    "dandelion": "90",               # stem probability %
+    "ttl": str(4 * 24 * 3600),
+    "stopresendingafterxdays": "0",  # 0 = never give up
+    "stopresendingafterxmonths": "0",
+    "apienabled": "false",
+    "apiport": "8442",
+    "apiinterface": "127.0.0.1",
+    "apiusername": "",
+    "apipassword": "",
+    "apivariant": "json",            # json | xml
+    "apinotifypath": "",
+    "smtpdeliver": "",
+    "smtpdenabled": "false",
+    "smtpdport": "8425",
+    "udp": "true",                   # LAN discovery
+    "upnp": "false",
+    "tls": "true",
+    "sockstype": "none",             # none | SOCKS5 | SOCKS4a
+    "sockshostname": "",
+    "socksport": "9050",
+    "socksusername": "",
+    "sockspassword": "",
+    "socksauthentication": "false",
+    "sockslisten": "false",
+    "onionhostname": "",
+    "namecoinrpctype": "namecoind",
+    "namecoinrpchost": "localhost",
+    "namecoinrpcport": "8336",
+    "namecoinrpcuser": "",
+    "namecoinrpcpassword": "",
+    "powlanes": "131072",            # TPU search lanes per chunk
+    "powchunks": "32",               # chunks per jitted call
+    "minimizeonclose": "false",
+    "replybelow": "false",
+    "timeformat": "%c",
+}
+
+
+def _validate_int_range(lo: int, hi: int) -> Callable[[str], bool]:
+    def check(value: str) -> bool:
+        try:
+            return lo <= int(value) <= hi
+        except ValueError:
+            return False
+    return check
+
+
+def _validate_bool(value: str) -> bool:
+    return value.lower() in ("true", "false", "0", "1", "yes", "no")
+
+
+#: per-option validators (reference validate_<section>_<option>,
+#: bmconfigparser.py:142-158 — notably maxoutbound <= 8)
+VALIDATORS: dict[str, Callable[[str], bool]] = {
+    "maxoutboundconnections": _validate_int_range(0, 8),
+    "maxtotalconnections": _validate_int_range(0, 10000),
+    "maxdownloadrate": _validate_int_range(0, 2**31),
+    "maxuploadrate": _validate_int_range(0, 2**31),
+    "dandelion": _validate_int_range(0, 100),
+    "port": _validate_int_range(0, 65535),
+    "apiport": _validate_int_range(1, 65535),
+    "smtpdport": _validate_int_range(1, 65535),
+    "socksport": _validate_int_range(1, 65535),
+    "ttl": _validate_int_range(300, 28 * 24 * 3600),
+    "powlanes": _validate_int_range(128, 1 << 24),
+    "powchunks": _validate_int_range(1, 4096),
+    "apienabled": _validate_bool,
+    "smtpdenabled": _validate_bool,
+    "udp": _validate_bool,
+    "upnp": _validate_bool,
+    "tls": _validate_bool,
+    "apivariant": lambda v: v in ("json", "xml"),
+    "sockstype": lambda v: v in ("none", "SOCKS5", "SOCKS4a"),
+}
+
+
+class SettingsError(ValueError):
+    """Rejected by a validator."""
+
+
+class Settings:
+    """Persisted node settings: defaults <- file <- temp overlay."""
+
+    def __init__(self, path: str | os.PathLike | None = None):
+        self._path = Path(path) if path else None
+        self._file: dict[str, str] = {}
+        self._temp: dict[str, str] = {}
+        if self._path is not None and self._path.exists():
+            self.load()
+        self._migrate()
+
+    # -- accessors -----------------------------------------------------------
+
+    def get(self, option: str, default: str | None = None) -> str:
+        if option in self._temp:
+            return self._temp[option]
+        if option in self._file:
+            return self._file[option]
+        if option in DEFAULTS:
+            return DEFAULTS[option]
+        if default is not None:
+            return default
+        raise KeyError(option)
+
+    def getint(self, option: str) -> int:
+        return int(self.get(option))
+
+    def getfloat(self, option: str) -> float:
+        return float(self.get(option))
+
+    def getbool(self, option: str) -> bool:
+        return self.get(option).lower() in ("true", "1", "yes")
+
+    def set(self, option: str, value) -> None:
+        """Set a persisted option (validated); call :meth:`save` to write."""
+        value = self._check(option, value)
+        self._file[option] = value
+        self._temp.pop(option, None)
+
+    def set_temp(self, option: str, value) -> None:
+        """Non-persisted overlay (reference setTemp) — CLI flags land here."""
+        self._temp[option] = self._check(option, value)
+
+    def _check(self, option: str, value) -> str:
+        if isinstance(value, bool):
+            value = "true" if value else "false"
+        value = str(value)
+        validator = VALIDATORS.get(option)
+        if validator is not None and not validator(value):
+            raise SettingsError("invalid value %r for option %r"
+                                % (value, option))
+        return value
+
+    def options(self) -> dict[str, str]:
+        """Effective settings (defaults overlaid by file and temp)."""
+        out = dict(DEFAULTS)
+        out.update(self._file)
+        out.update(self._temp)
+        return out
+
+    # -- persistence ---------------------------------------------------------
+
+    def load(self) -> None:
+        cfg = configparser.ConfigParser()
+        cfg.read(self._path)
+        if cfg.has_section(SECTION):
+            self._file = dict(cfg[SECTION])
+
+    def save(self) -> None:
+        """Atomic write with a timestamped .bak of the previous file
+        (reference bmconfigparser.py:120-140)."""
+        if self._path is None:
+            return
+        self._path.parent.mkdir(parents=True, exist_ok=True)
+        cfg = configparser.ConfigParser()
+        cfg[SECTION] = dict(self._file)
+        if self._path.exists():
+            bak = self._path.with_name(
+                self._path.name + "." + time.strftime("%Y%m%d-%H%M%S")
+                + ".bak")
+            try:
+                bak.write_bytes(self._path.read_bytes())
+            except OSError:
+                logger.warning("could not write settings backup %s", bak)
+        tmp = self._path.with_suffix(".tmp")
+        with open(tmp, "w") as f:
+            cfg.write(f)
+        tmp.replace(self._path)
+
+    # -- migrations ----------------------------------------------------------
+
+    def _migrate(self) -> None:
+        """Versioned upgrade chain (reference helper_startup.updateConfig)."""
+        try:
+            version = int(self._file.get("settingsversion",
+                                         str(SETTINGS_VERSION)))
+        except ValueError:
+            version = 1
+        dirty = False
+        if version < 2:
+            # v1 -> v2: dandelion option introduced; old installs ran
+            # with stem routing off
+            self._file.setdefault("dandelion", "0")
+            version = 2
+            dirty = True
+        if dirty:
+            self._file["settingsversion"] = str(version)
+            if self._path is not None:
+                self.save()

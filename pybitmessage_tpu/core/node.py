@@ -38,7 +38,8 @@ class Node:
                  port: int = 0, listen: bool = True,
                  solver=None, dandelion_enabled: bool = True,
                  allow_private_peers: bool = False,
-                 stream: int = 1, test_mode: bool = False):
+                 stream: int = 1, test_mode: bool = False,
+                 tls_enabled: bool = True, udp_enabled: bool = False):
         self.data_dir = Path(data_dir) if data_dir else None
         if self.data_dir:
             self.data_dir.mkdir(parents=True, exist_ok=True)
@@ -67,23 +68,48 @@ class Node:
             pow_ntpb=min_ntpb, pow_extra=min_extra)
         self.pool = ConnectionPool(self.ctx)
         self.listen = listen
+        if tls_enabled:
+            # opportunistic NODE_SSL (reference tls.py); cert is
+            # ephemeral and unverified — confidentiality only
+            self.ctx.enable_tls(
+                self.data_dir / "tls" if self.data_dir else None)
+        #: incoming-object PoW checks batched onto the device
+        from ..pow.verify_service import BatchVerifier
+        self.pow_verifier = BatchVerifier(
+            ntpb=min_ntpb, extra=min_extra, clamp=False)
+        self.ctx.pow_verifier = self.pow_verifier
         #: solver ladder: TPU -> C++ -> python (proofofwork.run analog)
         self.solver = solver or PowDispatcher()
+        #: batching front-end — only when the solver supports batches
+        self.pow_service = None
+        if hasattr(self.solver, "solve_batch"):
+            from ..pow.service import PowService
+            self.pow_service = PowService(self.solver,
+                                          shutdown=self.shutdown)
 
+        from .uisignal import UISignaler
+        self.ui = UISignaler()
         self.sender = SendWorker(
             keystore=self.keystore, store=self.store,
             inventory=self.inventory, pool=self.pool,
-            solver=self._solve, shutdown=self.shutdown,
-            min_ntpb=min_ntpb, min_extra=min_extra)
+            solver=self._solve, pow_service=self.pow_service,
+            shutdown=self.shutdown,
+            min_ntpb=min_ntpb, min_extra=min_extra,
+            ui_signal=self.ui.emit)
         self.processor = ObjectProcessor(
             keystore=self.keystore, store=self.store,
             inventory=self.inventory, sender=self.sender, pool=self.pool,
             shutdown=self.shutdown,
-            min_ntpb=min_ntpb, min_extra=min_extra)
+            min_ntpb=min_ntpb, min_extra=min_extra,
+            ui_signal=self.ui.emit)
         self.cleaner = Cleaner(
             inventory=self.inventory, store=self.store,
             knownnodes=self.knownnodes, sender=self.sender, pool=self.pool,
             shutdown=self.shutdown)
+        self.udp = None
+        if udp_enabled:
+            from ..network.udp import UDPDiscovery
+            self.udp = UDPDiscovery(self.pool)
         self._pump_task: asyncio.Task | None = None
 
     def _solve(self, initial_hash, target, should_stop=None):
@@ -92,10 +118,15 @@ class Node:
     # -- lifecycle -----------------------------------------------------------
 
     async def start(self) -> None:
+        if self.pow_service is not None:
+            self.pow_service.start()
+        self.pow_verifier.start()
         self.sender.start()
         self.processor.start()
         self.cleaner.start()
         await self.pool.start(listen=self.listen)
+        if self.udp is not None:
+            await self.udp.start()
         self._pump_task = asyncio.create_task(self._pump_objects())
         logger.info("node started (port %s)",
                     self.pool.listen_port if self.listen else "-")
@@ -111,10 +142,15 @@ class Node:
         self.shutdown.set()
         if self._pump_task:
             self._pump_task.cancel()
+        if self.udp is not None:
+            await self.udp.stop()
         await self.pool.stop()
         await self.sender.stop()
         await self.processor.stop()
         await self.cleaner.stop()
+        if self.pow_service is not None:
+            await self.pow_service.stop()
+        await self.pow_verifier.stop()
         self.inventory.flush()
         self.knownnodes.save()
         self.db.close()

@@ -1,0 +1,55 @@
+"""UI event fan-out: the reference's UISignalQueue command vocabulary.
+
+The reference decouples core from frontends through a queue of
+``(command, data)`` tuples drained by each UI (bitmessageqt/
+uisignaler.py:8-60 re-emits them as Qt signals; class_smtpDeliver.py
+consumes the same stream).  Commands used here (same names, so any
+frontend written against the reference vocabulary maps 1:1):
+
+- ``writeNewAddressToTable``      (label, address, stream)
+- ``displayNewInboxMessage``      (msgid, to, from, subject, body)
+- ``displayNewSentMessage``       (to, fromLabel, from, subject, body, ack)
+- ``updateSentItemStatusByAckdata`` (ackdata, status_text)
+- ``updateNetworkStatusTab``      (connected_count,)
+- ``updateStatusBar``             (text,)
+
+asyncio re-design: instead of one global queue with exactly-one
+consumer, a synchronous fan-out to any number of subscribers — each
+frontend gets every event without stealing them from the others.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Callable
+
+logger = logging.getLogger("pybitmessage_tpu.ui")
+
+
+class UISignaler:
+    """Synchronous multi-subscriber event bus for UI-facing events."""
+
+    def __init__(self):
+        self._subs: list[Callable[[str, tuple], None]] = []
+        #: ring of recent events (TUIs can render history on attach)
+        self.recent: list[tuple[str, tuple]] = []
+        self.max_recent = 200
+
+    def subscribe(self, callback: Callable[[str, tuple], None]) -> None:
+        self._subs.append(callback)
+
+    def unsubscribe(self, callback) -> None:
+        try:
+            self._subs.remove(callback)
+        except ValueError:
+            pass
+
+    def emit(self, command: str, data: tuple = ()) -> None:
+        self.recent.append((command, data))
+        if len(self.recent) > self.max_recent:
+            del self.recent[:len(self.recent) - self.max_recent]
+        for cb in list(self._subs):
+            try:
+                cb(command, data)
+            except Exception:
+                logger.exception("UI subscriber failed on %s", command)

@@ -1,0 +1,4 @@
+"""Protocol gateways: SMTP in/out (email <-> bitmessage bridging)."""
+
+from .smtp_server import SMTPGateway, SMTP_DOMAIN  # noqa: F401
+from .smtp_deliver import SMTPDeliverer  # noqa: F401

@@ -20,7 +20,7 @@ from typing import TYPE_CHECKING
 
 from ..models.constants import (
     MAGIC, MAX_MESSAGE_SIZE, MAX_OBJECT_COUNT, MAX_TIME_OFFSET,
-    NODE_DANDELION, PROTOCOL_VERSION,
+    NODE_DANDELION, NODE_SSL, PROTOCOL_VERSION,
 )
 from ..models.objects import ObjectError, ObjectHeader, check_by_type
 from ..models.packet import (
@@ -71,6 +71,7 @@ class BMConnection:
         self.verack_received = False
         self.verack_sent = False
         self.fully_established = False
+        self.tls_established = False
         self.last_activity = time.time()
         self._closed = False
         self.pending_upload: deque[bytes] = deque()
@@ -109,7 +110,9 @@ class BMConnection:
             self._task.cancel()
         try:
             self.writer.close()
-            await self.writer.wait_closed()
+            # bounded: a mid-handshake TLS transport can wedge the
+            # orderly-shutdown wait forever
+            await asyncio.wait_for(self.writer.wait_closed(), 3.0)
         except Exception:
             pass
         self.pool.connection_closed(self)
@@ -182,21 +185,59 @@ class BMConnection:
             # knownnodes/addr-gossip must use the peer's advertised
             # LISTENING port, not the ephemeral source port we accepted
             self.port = ver.my_port
-        await self.send_packet("verack")
-        self.verack_sent = True
-        if not self.outbound:
+        # Verack ordering carries the TLS upgrade barrier: the OUTBOUND
+        # side veracks as soon as it has the peer's version, but the
+        # INBOUND side defers its verack until the peer's verack has
+        # arrived.  That makes the inbound verack the guaranteed-last
+        # plaintext packet on the wire, so when the outbound side reads
+        # it and fires its ClientHello, the inbound side has already
+        # swapped its transport to TLS — no handshake bytes can strand
+        # in the plaintext stream buffer.  (The reference upgrades on
+        # the same verack boundary, bmproto.py:552-560, but relies on
+        # its hand-rolled socket buffers to tolerate the race.)
+        if self.outbound:
+            await self.send_packet("verack")
+            self.verack_sent = True
+        else:
             await self.send_version()
-        if self.verack_received:
+        if self.verack_sent and self.verack_received:
             await self._establish()
 
     async def cmd_verack(self, payload: bytes) -> None:
+        if not self.remote_protocol:
+            # verack before version: establishment would skip every
+            # peer-validity check (nonce/self-connect, protocol floor,
+            # time offset, stream overlap)
+            raise ConnectionClosed("verack before version")
         self.verack_received = True
+        if not self.outbound and not self.verack_sent:
+            await self.send_packet("verack")
+            self.verack_sent = True
         if self.verack_sent:
             await self._establish()
+
+    async def _upgrade_tls(self) -> None:
+        """Mid-stream TLS after the verack exchange (reference
+        tls.py:62-220; negotiated when both sides advertise NODE_SSL,
+        bmproto.py:552-560).  The verack is the last plaintext packet
+        each side sends before switching, so no framed data straddles
+        the upgrade."""
+        from .tls import make_client_context, make_server_context
+        if self.outbound:
+            tls_ctx = make_client_context()
+        else:
+            tls_ctx = make_server_context(*self.ctx.tls_files)
+        await self.writer.start_tls(tls_ctx, ssl_handshake_timeout=10)
+        self.tls_established = True
+        logger.debug("TLS established with %s:%s (%s)", self.host,
+                     self.port, self.writer.get_extra_info("cipher"))
 
     async def _establish(self) -> None:
         if self.fully_established:
             return
+        if self.ctx.tls_files is not None and self.services & NODE_SSL \
+                and self.ctx.services & NODE_SSL:
+            await self._upgrade_tls()
         self.fully_established = True
         await self._send_addr_sample()
         await self._send_big_inv()
@@ -304,8 +345,14 @@ class BMConnection:
             return
         if header.stream not in self.ctx.streams:
             return
-        if not check_pow(payload, self.ctx.pow_ntpb, self.ctx.pow_extra,
-                         clamp=False):
+        if self.ctx.pow_verifier is not None:
+            # batched device verification (flood traffic amortizes into
+            # one fused launch; SURVEY §7.7)
+            ok = await self.ctx.pow_verifier.check(payload)
+        else:
+            ok = check_pow(payload, self.ctx.pow_ntpb, self.ctx.pow_extra,
+                           clamp=False)
+        if not ok:
             logger.debug("insufficient PoW from %s", self.host)
             raise ConnectionClosed("object with insufficient PoW")
         h = inventory_hash(payload)

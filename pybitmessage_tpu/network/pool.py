@@ -12,6 +12,7 @@ from __future__ import annotations
 import asyncio
 import logging
 import random
+import socket
 import time
 from typing import Callable, Optional
 
@@ -22,6 +23,24 @@ from .ratelimit import TokenBucket
 from .tracker import GlobalTracker
 
 logger = logging.getLogger("pybitmessage_tpu.network")
+
+
+def _is_local_address(host: str) -> bool:
+    """True when ``host`` is one of this machine's own addresses.
+
+    Kernel routing trick, no interface enumeration: a UDP connect
+    (no packets sent) to a local address always selects that same
+    address as the source.
+    """
+    if host in ("127.0.0.1", "::1", "localhost"):
+        return True
+    try:
+        family = socket.AF_INET6 if ":" in host else socket.AF_INET
+        with socket.socket(family, socket.SOCK_DGRAM) as s:
+            s.connect((host, 9))
+            return s.getsockname()[0] == host
+    except OSError:
+        return False
 
 DEFAULT_MAX_OUTBOUND = 8
 DEFAULT_MAX_TOTAL = 200
@@ -60,6 +79,20 @@ class NodeContext:
         self.global_tracker = GlobalTracker()
         #: validated objects flow out here: (hash, header, payload)
         self.object_queue: asyncio.Queue = asyncio.Queue()
+        #: optional BatchVerifier — incoming objects' PoW checked in
+        #: fused device batches instead of one host hash pair each
+        self.pow_verifier = None
+        #: opportunistic TLS (NODE_SSL): (certfile, keyfile) or None.
+        #: Set via enable_tls(); adds NODE_SSL to our service flags.
+        self.tls_files: tuple[str, str] | None = None
+        #: SOCKS proxy for outbound dials (Tor support): None or a dict
+        #: {type: "SOCKS5"|"SOCKS4a", host, port, username, password}
+        self.proxy: dict | None = None
+
+    def enable_tls(self, directory=None) -> None:
+        from .tls import generate_self_signed_cert
+        self.tls_files = generate_self_signed_cert(directory)
+        self.services |= 2  # NODE_SSL
 
 
 class ConnectionPool:
@@ -78,6 +111,8 @@ class ConnectionPool:
         self._server: asyncio.AbstractServer | None = None
         self._tasks: list[asyncio.Task] = []
         self.on_object: Callable | None = None  # hook for the processor
+        #: LAN peers heard over UDP discovery -> last-heard time
+        self.lan_peers: dict[Peer, float] = {}
 
     # -- queries -------------------------------------------------------------
 
@@ -138,8 +173,17 @@ class ConnectionPool:
 
     async def connect_to(self, peer: Peer) -> BMConnection | None:
         try:
-            reader, writer = await asyncio.wait_for(
-                asyncio.open_connection(peer.host, peer.port), timeout=10)
+            if self.ctx.proxy is not None:
+                from .socks import open_via_proxy
+                p = self.ctx.proxy
+                reader, writer = await open_via_proxy(
+                    p["type"], p["host"], p["port"], peer.host, peer.port,
+                    username=p.get("username", ""),
+                    password=p.get("password", ""), timeout=30)
+            else:
+                reader, writer = await asyncio.wait_for(
+                    asyncio.open_connection(peer.host, peer.port),
+                    timeout=10)
         except (OSError, asyncio.TimeoutError) as exc:
             logger.debug("dial %s failed: %r", peer, exc)
             self.ctx.knownnodes.decrease_rating(peer)
@@ -164,6 +208,15 @@ class ConnectionPool:
             self.ctx.dandelion.remove_connection(conn)
         if conn.outbound and not conn.fully_established:
             self.ctx.knownnodes.decrease_rating(Peer(conn.host, conn.port))
+
+    def lan_peer_discovered(self, peer: Peer, stream: int = 1) -> None:
+        """A peer announced itself via LAN UDP broadcast — trusted more
+        than gossip (we heard it from its own source address) and
+        preferred by the dialer 50% of the time (reference
+        connectionchooser.py:57-62, state.discoveredPeers)."""
+        if peer.port == self.listen_port and _is_local_address(peer.host):
+            return  # our own broadcast echoed back from a local iface
+        self.lan_peers[peer] = time.time()
 
     def peer_discovered(self, entry: AddrEntry) -> None:
         # Reject unroutable addresses from gossip — loopback/private/
@@ -215,7 +268,14 @@ class ConnectionPool:
             return
         if len(self.outbound) >= self.max_outbound:
             return
-        peer = self.ctx.knownnodes.choose()
+        peer = None
+        # 50% preference for LAN-discovered peers (connectionchooser.py)
+        fresh_lan = [p for p, ts in self.lan_peers.items()
+                     if time.time() - ts < 10800]
+        if fresh_lan and random.random() < 0.5:
+            peer = random.choice(fresh_lan)
+        if peer is None:
+            peer = self.ctx.knownnodes.choose()
         if peer is None:
             return
         if peer in [Peer(c.host, c.port) for c in self.outbound]:

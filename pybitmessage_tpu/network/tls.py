@@ -1,0 +1,80 @@
+"""Opportunistic mid-stream TLS between peers (NODE_SSL).
+
+Reference behavior (src/network/tls.py:62-220, bmproto.py:552-560):
+after both veracks, when both peers advertise NODE_SSL, the stream is
+upgraded to TLS with NO certificate verification — the point is
+passive-eavesdropper confidentiality between anonymous peers, not
+authentication (the reference uses the anonymous AECDH-AES256-SHA
+cipher; modern OpenSSL removed anon ciphers, so this implementation
+uses an ephemeral self-signed certificate that the client deliberately
+does not verify — the same trust model on today's TLS stack).
+
+asyncio re-design: instead of a hand-rolled want_read/want_write
+handshake pump on a raw socket, ``StreamWriter.start_tls`` swaps the
+transport under the existing reader/writer, so the framed-packet code
+above is oblivious to the upgrade.
+"""
+
+from __future__ import annotations
+
+import datetime
+import logging
+import ssl
+import tempfile
+from pathlib import Path
+
+logger = logging.getLogger("pybitmessage_tpu.network")
+
+
+def generate_self_signed_cert(directory: str | Path | None = None,
+                              common_name: str = "bitmessage") \
+        -> tuple[str, str]:
+    """Write an ephemeral RSA self-signed cert; returns (cert, key) paths.
+
+    The cert carries no identity (clients never verify it) — it only
+    exists because OpenSSL 3 removed anonymous key agreement.
+    """
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import ec
+    from cryptography.x509.oid import NameOID
+
+    key = ec.generate_private_key(ec.SECP256R1())
+    name = x509.Name(
+        [x509.NameAttribute(NameOID.COMMON_NAME, common_name)])
+    now = datetime.datetime.now(datetime.timezone.utc)
+    cert = (x509.CertificateBuilder()
+            .subject_name(name).issuer_name(name)
+            .public_key(key.public_key())
+            .serial_number(x509.random_serial_number())
+            .not_valid_before(now - datetime.timedelta(days=1))
+            .not_valid_after(now + datetime.timedelta(days=3650))
+            .sign(key, hashes.SHA256()))
+
+    if directory is None:
+        directory = tempfile.mkdtemp(prefix="bmtls-")
+    directory = Path(directory)
+    directory.mkdir(parents=True, exist_ok=True)
+    cert_path = directory / "tls.crt"
+    key_path = directory / "tls.key"
+    cert_path.write_bytes(cert.public_bytes(serialization.Encoding.PEM))
+    key_path.write_bytes(key.private_bytes(
+        serialization.Encoding.PEM,
+        serialization.PrivateFormat.PKCS8,
+        serialization.NoEncryption()))
+    key_path.chmod(0o600)
+    return str(cert_path), str(key_path)
+
+
+def make_server_context(certfile: str, keyfile: str) -> ssl.SSLContext:
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    ctx.load_cert_chain(certfile, keyfile)
+    ctx.verify_mode = ssl.CERT_NONE
+    return ctx
+
+
+def make_client_context() -> ssl.SSLContext:
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+    ctx.check_hostname = False
+    ctx.verify_mode = ssl.CERT_NONE  # anonymity model: no cert trust
+    return ctx

@@ -23,14 +23,18 @@ import jax
 import jax.numpy as jnp
 
 from ..utils.hashes import double_sha512
-from .sha512_jax import double_sha512_trial, initial_hash_words, trial_values
+from .sha512_jax import (DEFAULT_VARIANT, double_sha512_trial,
+    initial_hash_words, trial_values)
 from .u64 import le64, u64_from_int, u64_to_int, U32
 
-#: lanes per while_loop iteration; multiple of 8*128 VPU tiles.  2^17 is
-#: the measured single-chip sweet spot (see BENCH notes in BASELINE.md).
-DEFAULT_LANES = 1 << 17
-#: while_loop iterations per jitted call (between shutdown checks).
-DEFAULT_CHUNKS_PER_CALL = 32
+#: lanes per while_loop iteration; multiple of 8*128 VPU tiles.
+#: 2^19 x 64 chunks (33.5M trials/slab) is the measured single-chip
+#: sweet spot: 25.5 MH/s honest vs 7 MH/s at 2^17 x 8, where per-call
+#: dispatch latency dominates (see BASELINE.md "Measured").
+DEFAULT_LANES = 1 << 19
+#: while_loop iterations per jitted call (between shutdown checks);
+#: one slab is ~1.3 s on a v5e chip — the shutdown-poll granularity.
+DEFAULT_CHUNKS_PER_CALL = 64
 
 
 class PowInterrupted(Exception):
@@ -73,14 +77,20 @@ def _run_host_driver(search_once, initial_hash: bytes, target: int, *,
         base += chunks * trials_per_call_step
 
 
-@functools.partial(jax.jit, static_argnames=("lanes", "max_chunks"))
+@functools.partial(jax.jit,
+                   static_argnames=("lanes", "max_chunks", "variant"))
 def pow_search_jit(ih_hi, ih_lo, target_hi, target_lo, start_hi, start_lo,
                    lanes: int = DEFAULT_LANES,
-                   max_chunks: int = DEFAULT_CHUNKS_PER_CALL):
+                   max_chunks: int = DEFAULT_CHUNKS_PER_CALL,
+                   variant: str = DEFAULT_VARIANT):
     """Search nonces [start, start + lanes*max_chunks) for value <= target.
 
     Returns (found: bool, nonce_hi, nonce_lo, chunks_done: int32).
-    Exits the loop at the first chunk containing a hit.
+    Exits the loop at the first chunk containing a hit.  ``variant``
+    selects the SHA-512 kernel (see ``sha512_jax.DEFAULT_VARIANT`` for
+    why "windowed" is the production default); dispatching the fastest
+    *usable* backend matches the reference wiring
+    (src/openclpow.py:96-107 + proofofwork.py:288-325).
     """
     lanes_pair = u64_from_int(lanes)
 
@@ -91,7 +101,7 @@ def pow_search_jit(ih_hi, ih_lo, target_hi, target_lo, start_hi, start_lo,
     def body(carry):
         found, chunk, base_hi, base_lo, nonce_hi, nonce_lo = carry
         (v_hi, v_lo), (n_hi, n_lo) = trial_values(
-            base_hi, base_lo, ih_hi, ih_lo, lanes)
+            base_hi, base_lo, ih_hi, ih_lo, lanes, variant)
         ok = le64((v_hi, v_lo), (target_hi, target_lo))
         hit = jnp.any(ok)
         idx = jnp.argmax(ok)  # first winning lane
@@ -113,6 +123,7 @@ def solve(initial_hash: bytes, target: int, *,
           start_nonce: int = 0,
           lanes: int = DEFAULT_LANES,
           chunks_per_call: int = DEFAULT_CHUNKS_PER_CALL,
+          variant: str = DEFAULT_VARIANT,
           should_stop: Callable[[], bool] | None = None):
     """Find a nonce whose trial value is <= target.
 
@@ -126,7 +137,7 @@ def solve(initial_hash: bytes, target: int, *,
 
     def search_once(b_hi, b_lo):
         return pow_search_jit(ih_hi, ih_lo, t_hi, t_lo, b_hi, b_lo,
-                              lanes, chunks_per_call)
+                              lanes, chunks_per_call, variant)
 
     return _run_host_driver(
         search_once, initial_hash, target, start_nonce=start_nonce,

@@ -49,10 +49,27 @@ _H0 = [
     0x510E527FADE682D1, 0x9B05688C2B3E6C1F, 0x1F83D9ABFB41BD6B, 0x5BE0CD19137E2179,
 ]
 
-K_HI = jnp.array([k >> 32 for k in _K], dtype=U32)
-K_LO = jnp.array([k & 0xFFFFFFFF for k in _K], dtype=U32)
-H0_HI = tuple(jnp.uint32(h >> 32) for h in _H0)
-H0_LO = tuple(jnp.uint32(h & 0xFFFFFFFF) for h in _H0)
+# Constant tables as NUMPY arrays: jnp constants at module scope would
+# initialize the accelerator backend for any process that merely
+# imports the package (and on a shared TPU tunnel, grab the chip), and
+# jnp constants created lazily inside a trace become tracers that must
+# not be cached across traces.  numpy values embed as XLA constants at
+# every trace with neither problem.
+import numpy as _np
+
+
+def _k_tables():
+    # reshaped (5, 16): each 16-round chunk does one dynamic row lookup
+    # instead of 80 scalar gathers
+    k_hi = _np.array([k >> 32 for k in _K], dtype=_np.uint32)
+    k_lo = _np.array([k & 0xFFFFFFFF for k in _K], dtype=_np.uint32)
+    return k_hi.reshape(5, 16), k_lo.reshape(5, 16)
+
+
+def _h0_pairs():
+    hi = tuple(_np.uint32(h >> 32) for h in _H0)
+    lo = tuple(_np.uint32(h & 0xFFFFFFFF) for h in _H0)
+    return hi, lo
 
 
 def _big_sigma0(x):
@@ -83,12 +100,6 @@ def _small_sigma1(x):
     return a[0] ^ b[0] ^ c[0], a[1] ^ b[1] ^ c[1]
 
 
-#: round constants reshaped to (5, 16) so each 16-round chunk does one
-#: dynamic row lookup instead of 80 scalar gathers
-K2_HI = K_HI.reshape(5, 16)
-K2_LO = K_LO.reshape(5, 16)
-
-
 def sha512_block(w_hi, w_lo):
     """One SHA-512 compression over a single padded block.
 
@@ -104,6 +115,8 @@ def sha512_block(w_hi, w_lo):
     at ~1/5 the compile cost of fully unrolling all 80 rounds).
     """
     batch_shape = w_hi.shape[1:]
+    k2_hi, k2_lo = _k_tables()
+    h0_hi, h0_lo = _h0_pairs()
 
     def bc(x):
         return jnp.broadcast_to(x, batch_shape) if batch_shape else x
@@ -111,8 +124,8 @@ def sha512_block(w_hi, w_lo):
     def chunk_body(k, carry):
         a, b, c, d, e, f, g, h = carry[:8]
         w = [(carry[8][i], carry[9][i]) for i in range(16)]
-        k_hi = jax.lax.dynamic_index_in_dim(K2_HI, k, keepdims=False)
-        k_lo = jax.lax.dynamic_index_in_dim(K2_LO, k, keepdims=False)
+        k_hi = jax.lax.dynamic_index_in_dim(k2_hi, k, keepdims=False)
+        k_lo = jax.lax.dynamic_index_in_dim(k2_lo, k, keepdims=False)
         for j in range(16):
             wt = w[j]
             kt = (k_hi[j], k_lo[j])
@@ -134,12 +147,12 @@ def sha512_block(w_hi, w_lo):
         wl = jnp.stack([x[1] for x in w])
         return (a, b, c, d, e, f, g, h, wh, wl)
 
-    state = tuple((bc(H0_HI[i]), bc(H0_LO[i])) for i in range(8))
+    state = tuple((bc(h0_hi[i]), bc(h0_lo[i])) for i in range(8))
     carry = (*state, w_hi, w_lo)
     carry = jax.lax.fori_loop(0, 5, chunk_body, carry)
     final = carry[:8]
 
-    out = tuple(add64((H0_HI[i], H0_LO[i]), final[i]) for i in range(8))
+    out = tuple(add64((h0_hi[i], h0_lo[i]), final[i]) for i in range(8))
     out_hi = jnp.stack([o[0] for o in out])
     out_lo = jnp.stack([o[1] for o in out])
     return out_hi, out_lo
@@ -195,10 +208,28 @@ def double_sha512_trial(nonce_hi, nonce_lo, ih_hi, ih_lo):
     return h2_hi[0], h2_lo[0]
 
 
-def trial_values(base_hi, base_lo, ih_hi, ih_lo, lanes: int):
-    """Trial values for nonces base .. base+lanes-1 (u64 pair base)."""
+#: production SHA-512 kernel variant.  "windowed" (the fori_loop kernel
+#: below) is the default: the fully-unrolled variant emits a ~3200-op
+#: straight-line graph that the TPU toolchain takes prohibitively long
+#: to compile (>9 min observed vs ~7 s for windowed), which no runtime
+#: advantage can amortize for a daemon that compiles at startup.
+DEFAULT_VARIANT = "windowed"
+
+
+def trial_values(base_hi, base_lo, ih_hi, ih_lo, lanes: int,
+                 variant: str = DEFAULT_VARIANT):
+    """Trial values for nonces base .. base+lanes-1 (u64 pair base).
+
+    ``variant``: "windowed" (the fori_loop kernel here — production
+    default, see DEFAULT_VARIANT) or "unrolled" (sha512_unrolled —
+    static schedule; faster per-step in interpret/CPU tests but its
+    TPU compile time is prohibitive).
+    """
     lane = jax.lax.broadcasted_iota(U32, (lanes, 1), 0).reshape(lanes)
     lo = base_lo + lane
     carry = (lo < base_lo).astype(U32)
     hi = jnp.broadcast_to(base_hi, (lanes,)) + carry
+    if variant == "unrolled":
+        from .sha512_unrolled import double_sha512_trial_unrolled
+        return double_sha512_trial_unrolled(hi, lo, ih_hi, ih_lo), (hi, lo)
     return double_sha512_trial(hi, lo, ih_hi, ih_lo), (hi, lo)

@@ -3,9 +3,10 @@
 Differences from the XLA path (pow_search.py): the entire search slab
 runs inside ONE kernel — the round state (24 uint32 tile pairs) lives
 in VMEM/registers across all 160 rounds and all grid steps, instead of
-being materialized to HBM at every fori_loop iteration boundary.  A
-SMEM "found" flag carried across the sequential grid gives early exit:
-once a block hits, later blocks skip their compute.
+being materialized to HBM at every fori_loop iteration boundary.  An
+SMEM scratch "found" flag carried across the sequential grid gives
+early exit: once a step hits, every later step's search body is skipped
+via ``pl.when`` and only writes its zeroed output row.
 
 Layout: grid = (chunks,); each grid step evaluates a (ROWS, 128) tile
 of nonces = base + step*ROWS*128 + lane.  Outputs per step: hit flag
@@ -101,11 +102,22 @@ def _broadcast_pair(pair, shape):
     return (jnp.broadcast_to(pair[0], shape), jnp.broadcast_to(pair[1], shape))
 
 
-def _kernel(ih_ref, base_ref, target_ref, found_ref, nonce_ref, *,
+def _kernel(ih_ref, base_ref, target_ref, found_ref, nonce_ref, flag_ref, *,
             rows: int):
     step = pl.program_id(0)
     shape = (rows, LANE_COLS)
 
+    @pl.when(step == 0)
+    def _init_flag():
+        flag_ref[0] = jnp.int32(0)
+
+    # Every step owns one output row; default it so skipped steps don't
+    # leave garbage in the (uninitialized) SMEM output buffer.
+    found_ref[step, 0] = jnp.int32(0)
+    nonce_ref[step, 0] = jnp.uint32(0)
+    nonce_ref[step, 1] = jnp.uint32(0)
+
+    @pl.when(flag_ref[0] == 0)
     def do_search():
         lane = (jax.lax.broadcasted_iota(U32, shape, 0)
                 * jnp.uint32(LANE_COLS)
@@ -146,12 +158,11 @@ def _kernel(ih_ref, base_ref, target_ref, found_ref, nonce_ref, *,
         hit = win_i != big
         win = win_i.astype(U32)
         found_ref[step, 0] = hit.astype(jnp.int32)
+        flag_ref[0] = hit.astype(jnp.int32)
         wl = base_lo + offset + win
         wc = (wl < base_lo).astype(U32)
         nonce_ref[step, 0] = base_hi + wc
         nonce_ref[step, 1] = wl
-
-    do_search()
 
 
 @functools.partial(jax.jit, static_argnames=("rows", "chunks", "interpret"))
@@ -179,6 +190,46 @@ def pallas_search(ih_words, base, target, rows: int = 256,
             pl.BlockSpec(memory_space=pltpu.SMEM),
             pl.BlockSpec(memory_space=pltpu.SMEM),
         ),
+        scratch_shapes=[pltpu.SMEM((1,), jnp.int32)],
         interpret=interpret,
     )(ih_words, base, target)
     return found[:, 0], nonce
+
+
+def solve(initial_hash: bytes, target: int, *,
+          start_nonce: int = 0, rows: int = 256,
+          chunks_per_call: int = 16, should_stop=None,
+          interpret: bool = False):
+    """Find a nonce whose trial value is <= target (Pallas backend).
+
+    Same contract as :func:`pow_search.solve`: returns
+    ``(nonce, trials_done)`` or raises ``PowInterrupted``.  The host
+    re-invokes the kernel in slabs of ``chunks_per_call * rows * 128``
+    trials so the shutdown callback stays responsive (reference host
+    loop: src/openclpow.py:96-107).
+    """
+    import numpy as np
+
+    from .pow_search import _run_host_driver
+
+    words = [int.from_bytes(initial_hash[i:i + 8], "big")
+             for i in range(0, 64, 8)]
+    ih_words = jnp.array([[w >> 32, w & 0xFFFFFFFF] for w in words],
+                         dtype=U32)
+    target &= (1 << 64) - 1
+    target_arr = jnp.array([target >> 32, target & 0xFFFFFFFF], dtype=U32)
+
+    def search_once(b_hi, b_lo):
+        base = jnp.stack([b_hi, b_lo])
+        found, nonce = pallas_search(ih_words, base, target_arr,
+                                     rows=rows, chunks=chunks_per_call,
+                                     interpret=interpret)
+        f = np.asarray(found)
+        idx = int(f.argmax())
+        if f[idx]:
+            return True, nonce[idx, 0], nonce[idx, 1], idx + 1
+        return False, jnp.uint32(0), jnp.uint32(0), chunks_per_call
+
+    return _run_host_driver(
+        search_once, initial_hash, target, start_nonce=start_nonce,
+        trials_per_call_step=rows * LANE_COLS, should_stop=should_stop)

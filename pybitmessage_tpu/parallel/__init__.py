@@ -9,5 +9,6 @@ striding (src/bitmsghash/bitmsghash.cpp:76-125).
 
 from .mesh import make_mesh  # noqa: F401
 from .pow_sharded import (  # noqa: F401
-    make_sharded_batch_search, make_sharded_search, sharded_solve,
+    get_sharded_batch_search, get_sharded_search, make_sharded_batch_search,
+    make_sharded_search, sharded_solve, sharded_solve_batch,
 )

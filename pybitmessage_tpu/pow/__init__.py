@@ -9,3 +9,5 @@ native tier is a self-built C++ pthread solver.
 
 from .dispatcher import PowDispatcher, python_solve  # noqa: F401
 from .native import NativeSolver  # noqa: F401
+from .service import PowService  # noqa: F401
+from .verify_service import BatchVerifier  # noqa: F401

@@ -39,7 +39,13 @@ def python_solve(initial_hash: bytes, target: int, *,
 
 
 class PowDispatcher:
-    """Callable solver with the GPU->C->python fallback ladder."""
+    """Callable solver with the GPU->C->python fallback ladder.
+
+    When more than one accelerator device is visible, single solves are
+    range-partitioned across the whole mesh (``sharded_solve``) and
+    :meth:`solve_batch` maps a queue of pending objects onto a 2D
+    (objects x nonce-range) mesh — the pod-wide production path.
+    """
 
     def __init__(self, *, use_tpu: bool = True, use_native: bool = True,
                  tpu_kwargs: dict | None = None, num_threads: int = 0):
@@ -48,6 +54,34 @@ class PowDispatcher:
         self._native = NativeSolver(num_threads) if use_native else None
         self.last_backend = ""
         self.last_rate = 0.0
+        self._meshes: dict = {}
+
+    # -- device topology -----------------------------------------------------
+
+    def _device_count(self) -> int:
+        try:
+            import jax
+            return len(jax.devices())
+        except Exception:
+            return 0
+
+    def _mesh(self, ndev: int, batch: int):
+        """(obj x nonce) mesh for ``batch`` objects; 1D when batch == 1."""
+        obj_size = 1
+        if batch > 1:
+            for d in range(min(ndev, batch), 0, -1):
+                if ndev % d == 0:
+                    obj_size = d
+                    break
+        key = (ndev, obj_size)
+        if key not in self._meshes:
+            from ..parallel import make_mesh
+            if obj_size == 1:
+                self._meshes[key] = make_mesh(ndev)
+            else:
+                self._meshes[key] = make_mesh(
+                    ndev, obj_axis="obj", obj_size=obj_size)
+        return self._meshes[key]
 
     def backends(self) -> list[str]:
         out = []
@@ -71,9 +105,53 @@ class PowDispatcher:
     # keep the explicit name too
     solve = __call__
 
+    def solve_batch(self, items, *, should_stop=None):
+        """Solve ``[(initial_hash, target), ...]`` -> ``[(nonce, trials)]``.
+
+        All pending objects go down in ONE pod-wide launch when a
+        multi-device mesh is available (objects data-parallel x nonce
+        range partitioned); otherwise objects are solved sequentially
+        through the normal ladder.
+        """
+        items = list(items)
+        if not items:
+            return []
+        t0 = time.monotonic()
+        results = None
+        if self._tpu_enabled and len(items) > 1:
+            ndev = self._device_count()
+            if ndev > 1:
+                try:
+                    from ..parallel import sharded_solve_batch
+                    self.last_backend = "tpu-batch"
+                    results = sharded_solve_batch(
+                        items, self._mesh(ndev, len(items)),
+                        should_stop=should_stop, **self.tpu_kwargs)
+                except PowInterrupted:
+                    raise
+                except Exception:
+                    logger.exception(
+                        "batched TPU PoW failed; falling back to "
+                        "per-object solves")
+        if results is None:
+            results = [self._solve(ih, t, 0, should_stop)
+                       for ih, t in items]
+        dt = max(time.monotonic() - t0, 1e-9)
+        self.last_rate = sum(r[1] for r in results) / dt
+        return results
+
     def _solve(self, initial_hash, target, start_nonce, should_stop):
         if self._tpu_enabled:
             try:
+                ndev = self._device_count()
+                if ndev > 1:
+                    # pod-wide nonce partition over ICI
+                    from ..parallel import sharded_solve
+                    self.last_backend = "tpu-sharded"
+                    return sharded_solve(
+                        initial_hash, target, self._mesh(ndev, 1),
+                        start_nonce=start_nonce, should_stop=should_stop,
+                        **self.tpu_kwargs)
                 from ..ops.pow_search import solve as tpu_solve
                 self.last_backend = "tpu"
                 return tpu_solve(initial_hash, target,

@@ -19,7 +19,7 @@ logger = logging.getLogger("pybitmessage_tpu.pow")
 
 _NATIVE_DIR = Path(__file__).resolve().parent.parent.parent / "native" / "pow"
 _LIB = _NATIVE_DIR / "libbitmsgpow.so"
-UINT64_MAX = 2**64 - 1
+_SRC = _NATIVE_DIR / "bitmsgpow.cpp"
 
 
 class NativeSolver:
@@ -40,7 +40,13 @@ class NativeSolver:
             return False
 
     def _load(self):
-        if not _LIB.exists() and not self._build():
+        stale = (_LIB.exists() and _SRC.exists()
+                 and _LIB.stat().st_mtime < _SRC.stat().st_mtime)
+        if (not _LIB.exists() or stale) and not self._build():
+            # never load a stale library: an ABI-mismatched .so would
+            # pass the (ABI-agnostic) self-test yet misreport results
+            logger.error("native solver unbuildable%s; disabled",
+                         " and stale" if stale else "")
             return None
         try:
             lib = ctypes.CDLL(str(_LIB))
@@ -48,7 +54,8 @@ class NativeSolver:
             lib.tpu_bm_pow_solve.argtypes = [
                 ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint64,
                 ctypes.c_int, ctypes.POINTER(ctypes.c_int),
-                ctypes.POINTER(ctypes.c_uint64)]
+                ctypes.POINTER(ctypes.c_uint64),
+                ctypes.POINTER(ctypes.c_int)]
             lib.tpu_bm_pow_trial.restype = ctypes.c_uint64
             lib.tpu_bm_pow_trial.argtypes = [ctypes.c_char_p,
                                              ctypes.c_uint64]
@@ -84,6 +91,7 @@ class NativeSolver:
             raise RuntimeError("native solver unavailable")
         stop_flag = ctypes.c_int(0)
         trials_out = ctypes.c_uint64(0)
+        found_out = ctypes.c_int(0)
         watcher_done = threading.Event()
 
         def watch():
@@ -97,11 +105,12 @@ class NativeSolver:
         try:
             nonce = self._lib.tpu_bm_pow_solve(
                 initial_hash, target, start_nonce, self.num_threads,
-                ctypes.byref(stop_flag), ctypes.byref(trials_out))
+                ctypes.byref(stop_flag), ctypes.byref(trials_out),
+                ctypes.byref(found_out))
         finally:
             watcher_done.set()
             watcher.join()
-        if nonce == UINT64_MAX:
+        if not found_out.value:
             from ..ops.pow_search import PowInterrupted
             raise PowInterrupted("native PoW interrupted")
         return nonce, int(trials_out.value)

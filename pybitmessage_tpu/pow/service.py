@@ -1,0 +1,88 @@
+"""Async PoW front-end: coalesces concurrent solves into one batch.
+
+The reference worker solves strictly one object at a time
+(src/class_singleWorker.py:1274-1276).  Here every concurrently pending
+solve joins a single pod-wide launch: requests are queued, a short
+coalescing window lets the rest of a send sweep arrive, and the whole
+batch goes through :meth:`PowDispatcher.solve_batch` — objects
+data-parallel over the mesh's object axis, each nonce range partitioned
+over the remaining chips (SURVEY §6: grid = nonce-lanes x objects).
+
+A single queued object never waits more than ``window`` seconds (the
+latency/batching tradeoff called out in SURVEY §7: dynamic batch
+assembly with padding, no recompilation per batch size thanks to the
+object-axis padding in ``sharded_solve_batch``).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+
+logger = logging.getLogger("pybitmessage_tpu.pow")
+
+
+class PowService:
+    """Owns a background task that drains solve requests in batches."""
+
+    def __init__(self, dispatcher, *, shutdown: asyncio.Event | None = None,
+                 window: float = 0.05):
+        self.dispatcher = dispatcher
+        self.shutdown = shutdown or asyncio.Event()
+        self.window = window
+        self.queue: asyncio.Queue = asyncio.Queue()
+        self._task: asyncio.Task | None = None
+        #: stats for clientStatus / observability
+        self.batches = 0
+        self.solved = 0
+
+    def start(self) -> asyncio.Task:
+        self._task = asyncio.create_task(self._run())
+        return self._task
+
+    async def stop(self) -> None:
+        if self._task:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+
+    async def solve(self, initial_hash: bytes, target: int):
+        """Queue one solve; returns (nonce, trials) when its batch lands."""
+        fut = asyncio.get_running_loop().create_future()
+        await self.queue.put((initial_hash, target, fut))
+        return await fut
+
+    async def _run(self) -> None:
+        while True:
+            first = await self.queue.get()
+            if self.window > 0:
+                await asyncio.sleep(self.window)
+            batch = [first]
+            while not self.queue.empty():
+                batch.append(self.queue.get_nowait())
+            items = [(ih, t) for ih, t, _ in batch]
+            loop = asyncio.get_running_loop()
+            try:
+                results = await loop.run_in_executor(
+                    None, lambda: self.dispatcher.solve_batch(
+                        items, should_stop=self.shutdown.is_set))
+            except asyncio.CancelledError:
+                for *_, fut in batch:
+                    if not fut.done():
+                        fut.cancel()
+                raise
+            except Exception as exc:
+                for *_, fut in batch:
+                    if not fut.done():
+                        fut.set_exception(exc)
+                continue
+            self.batches += 1
+            self.solved += len(batch)
+            if len(batch) > 1:
+                logger.info("batched PoW: %d objects in one launch (%s)",
+                            len(batch), self.dispatcher.last_backend)
+            for (_, _, fut), res in zip(batch, results):
+                if not fut.done():
+                    fut.set_result(res)

@@ -249,3 +249,19 @@ class MessageStore:
         return self._db.execute(
             "DELETE FROM pubkeys WHERE time<? AND usedpersonally='no'",
             (int(time.time()) - max_age,))
+
+    # -- objectprocessorqueue persistence ------------------------------------
+    # Unprocessed network objects survive a restart (reference
+    # class_objectProcessor.py:47-60 replay, 111-127 shutdown flush).
+
+    def persist_objectprocessor_queue(self, payloads: list[bytes]) -> None:
+        for p in payloads:
+            objtype = int.from_bytes(p[16:20], "big") if len(p) >= 20 else 0
+            self._db.execute(
+                "INSERT INTO objectprocessorqueue (objecttype, data) "
+                "VALUES (?, ?)", (objtype, p))
+
+    def pop_objectprocessor_queue(self) -> list[bytes]:
+        rows = self._db.query("SELECT data FROM objectprocessorqueue")
+        self._db.execute("DELETE FROM objectprocessorqueue")
+        return [bytes(r[0]) for r in rows]

@@ -1,0 +1,49 @@
+"""Byte-size-bounded asyncio queue.
+
+Reference semantics (src/queues.py:14-38): the objectProcessorQueue
+caps *unprocessed payload bytes* at 32 MB and blocks producers — a
+flood of large objects stalls the network readers instead of ballooning
+memory.  This is the asyncio re-expression: ``put`` awaits while the
+buffered byte total is at/over the cap; ``get`` frees budget and wakes
+waiters.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+DEFAULT_MAX_BYTES = 32 * 1024 * 1024
+
+
+class ByteBoundedQueue(asyncio.Queue):
+    """FIFO of ``bytes`` items bounded by their summed length."""
+
+    def __init__(self, max_bytes: int = DEFAULT_MAX_BYTES):
+        super().__init__()
+        self.max_bytes = max_bytes
+        self.pending_bytes = 0
+        self._space = asyncio.Event()
+        self._space.set()
+
+    # NOTE: asyncio.Queue.put()/get() delegate to put_nowait()/
+    # get_nowait(), so byte accounting lives ONLY in the _nowait pair —
+    # the async wrappers just add the space-wait.
+
+    async def put(self, item: bytes) -> None:
+        while self.pending_bytes >= self.max_bytes:
+            self._space.clear()
+            await self._space.wait()
+        await super().put(item)          # delegates to our put_nowait
+
+    def put_nowait(self, item: bytes) -> None:
+        if self.pending_bytes >= self.max_bytes:
+            raise asyncio.QueueFull
+        self.pending_bytes += len(item)
+        super().put_nowait(item)
+
+    def get_nowait(self) -> bytes:
+        item = super().get_nowait()      # also serves Queue.get()
+        self.pending_bytes -= len(item)
+        if self.pending_bytes < self.max_bytes:
+            self._space.set()
+        return item

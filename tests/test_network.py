@@ -182,3 +182,33 @@ async def test_addr_gossip_populates_knownnodes():
     finally:
         await pool_b.stop()
         await pool_a.stop()
+
+
+@pytest.mark.asyncio
+async def test_verack_before_version_is_rejected():
+    """A bare verack as the first packet must not establish the
+    connection — it would bypass every peerValidityChecks gate
+    (nonce/self-connect, protocol floor, time offset, streams)."""
+    from pybitmessage_tpu.models.packet import pack_packet
+
+    ctx_a, pool_a = _make_node()
+    await pool_a.start()
+    try:
+        reader, writer = await asyncio.open_connection(
+            "127.0.0.1", pool_a.listen_port)
+        writer.write(pack_packet("verack"))
+        await writer.drain()
+        # server must drop us without ever sending its own verack or
+        # any establishment traffic (addr sample / big inv)
+        data = await asyncio.wait_for(reader.read(4096), timeout=5)
+        while True:
+            more = await asyncio.wait_for(reader.read(4096), timeout=5)
+            if not more:
+                break
+            data += more
+        assert b"verack" not in data
+        assert b"addr" not in data
+        assert not any(c.fully_established for c in pool_a.connections())
+        writer.close()
+    finally:
+        await pool_a.stop()

@@ -1,0 +1,210 @@
+"""SOCKS5 / SOCKS4a negotiation against scripted fake proxies
+(VERDICT r1 #6), plus an end-to-end proxied node dial."""
+
+import asyncio
+import struct
+
+import pytest
+
+from pybitmessage_tpu.core import Node
+from pybitmessage_tpu.network.socks import (
+    SocksError, open_via_proxy, socks4a_connect, socks5_connect,
+)
+from pybitmessage_tpu.storage.knownnodes import Peer
+
+
+class FakeSocks5:
+    """Minimal RFC 1928/1929 server that then tunnels to a target."""
+
+    def __init__(self, *, require_auth=False, user=b"u", pwd=b"p",
+                 reject_code=0):
+        self.require_auth = require_auth
+        self.user, self.pwd = user, pwd
+        self.reject_code = reject_code
+        self.connected_to = None
+        self.server = None
+
+    async def start(self):
+        self.server = await asyncio.start_server(
+            self._handle, "127.0.0.1", 0)
+        return self.server.sockets[0].getsockname()[1]
+
+    async def stop(self):
+        self.server.close()
+        await self.server.wait_closed()
+
+    async def _handle(self, reader, writer):
+        try:
+            ver, n = await reader.readexactly(2)
+            methods = await reader.readexactly(n)
+            if self.require_auth:
+                writer.write(b"\x05\x02")
+                v, ulen = await reader.readexactly(2)
+                user = await reader.readexactly(ulen)
+                plen = (await reader.readexactly(1))[0]
+                pwd = await reader.readexactly(plen)
+                ok = user == self.user and pwd == self.pwd
+                writer.write(b"\x01" + (b"\x00" if ok else b"\x01"))
+                if not ok:
+                    writer.close()
+                    return
+            else:
+                writer.write(b"\x05\x00")
+            await writer.drain()
+            ver, cmd, _, atyp = await reader.readexactly(4)
+            if atyp == 1:
+                host = ".".join(map(str, await reader.readexactly(4)))
+            elif atyp == 3:
+                ln = (await reader.readexactly(1))[0]
+                host = (await reader.readexactly(ln)).decode()
+            port = struct.unpack(">H", await reader.readexactly(2))[0]
+            self.connected_to = (host, port)
+            if self.reject_code:
+                writer.write(b"\x05" + bytes([self.reject_code])
+                             + b"\x00\x01" + b"\x00" * 6)
+                await writer.drain()
+                writer.close()
+                return
+            writer.write(b"\x05\x00\x00\x01" + b"\x00" * 6)
+            await writer.drain()
+            # tunnel both directions
+            tr, tw = await asyncio.open_connection(host, port)
+
+            async def pump(src, dst):
+                try:
+                    while True:
+                        data = await src.read(65536)
+                        if not data:
+                            break
+                        dst.write(data)
+                        await dst.drain()
+                except (ConnectionError, asyncio.CancelledError):
+                    pass
+                finally:
+                    try:
+                        dst.close()
+                    except Exception:
+                        pass
+
+            await asyncio.gather(pump(reader, tw), pump(tr, writer))
+        except (asyncio.IncompleteReadError, ConnectionError):
+            pass
+
+
+@pytest.mark.asyncio
+async def test_socks5_no_auth_negotiation():
+    proxy = FakeSocks5()
+    # target: a trivial echo server
+    async def echo(r, w):
+        w.write(await r.read(5))
+        await w.drain()
+        w.close()
+    target = await asyncio.start_server(echo, "127.0.0.1", 0)
+    tport = target.sockets[0].getsockname()[1]
+    pport = await proxy.start()
+    try:
+        reader, writer = await open_via_proxy(
+            "SOCKS5", "127.0.0.1", pport, "127.0.0.1", tport)
+        assert proxy.connected_to == ("127.0.0.1", tport)
+        writer.write(b"hello")
+        await writer.drain()
+        assert await reader.readexactly(5) == b"hello"
+        writer.close()
+    finally:
+        await proxy.stop()
+        target.close()
+
+
+@pytest.mark.asyncio
+async def test_socks5_auth_and_domain():
+    proxy = FakeSocks5(require_auth=True, user=b"alice", pwd=b"secret")
+    async def noop(r, w):
+        w.close()
+    target = await asyncio.start_server(noop, "127.0.0.1", 0)
+    pport = await proxy.start()
+    try:
+        r, w = await asyncio.open_connection("127.0.0.1", pport)
+        await socks5_connect(
+            r, w, "localhost",
+            target.sockets[0].getsockname()[1],
+            username="alice", password="secret")
+        assert proxy.connected_to[0] == "localhost"  # remote DNS form
+        w.close()
+    finally:
+        await proxy.stop()
+        target.close()
+
+
+@pytest.mark.asyncio
+async def test_socks5_rejection_raises():
+    proxy = FakeSocks5(reject_code=5)  # connection refused
+    pport = await proxy.start()
+    try:
+        with pytest.raises(SocksError, match="refused"):
+            await open_via_proxy("SOCKS5", "127.0.0.1", pport,
+                                 "127.0.0.1", 1)
+    finally:
+        await proxy.stop()
+
+
+@pytest.mark.asyncio
+async def test_socks4a_negotiation():
+    received = {}
+
+    async def fake4a(reader, writer):
+        hdr = await reader.readexactly(8)
+        received["port"] = struct.unpack(">H", hdr[2:4])[0]
+        received["marker"] = hdr[4:8]
+        user = b""
+        while (c := await reader.readexactly(1)) != b"\x00":
+            user += c
+        hostname = b""
+        while (c := await reader.readexactly(1)) != b"\x00":
+            hostname += c
+        received["hostname"] = hostname.decode()
+        writer.write(b"\x00\x5a" + b"\x00" * 6)
+        await writer.drain()
+
+    server = await asyncio.start_server(fake4a, "127.0.0.1", 0)
+    port = server.sockets[0].getsockname()[1]
+    try:
+        r, w = await asyncio.open_connection("127.0.0.1", port)
+        await socks4a_connect(r, w, "example.onion", 8444)
+        assert received["hostname"] == "example.onion"
+        assert received["marker"] == b"\x00\x00\x00\x01"
+        assert received["port"] == 8444
+        w.close()
+    finally:
+        server.close()
+
+
+@pytest.mark.asyncio
+async def test_node_dials_through_socks5_proxy():
+    """Full stack: pool dial -> SOCKS5 tunnel -> handshake completes."""
+    node_a = Node(listen=True, solver=lambda *a, **k: (0, 0),
+                  test_mode=True, allow_private_peers=True,
+                  dandelion_enabled=False, tls_enabled=False)
+    node_b = Node(listen=False, solver=lambda *a, **k: (0, 0),
+                  test_mode=True, allow_private_peers=True,
+                  dandelion_enabled=False, tls_enabled=False)
+    proxy = FakeSocks5()
+    pport = await proxy.start()
+    await node_a.start()
+    await node_b.start()
+    node_b.ctx.proxy = {"type": "SOCKS5", "host": "127.0.0.1",
+                        "port": pport}
+    try:
+        conn = await node_b.pool.connect_to(
+            Peer("127.0.0.1", node_a.pool.listen_port))
+        assert conn is not None
+        for _ in range(100):
+            if conn.fully_established:
+                break
+            await asyncio.sleep(0.05)
+        assert conn.fully_established
+        assert proxy.connected_to == ("127.0.0.1",
+                                      node_a.pool.listen_port)
+    finally:
+        await node_b.stop()
+        await node_a.stop()
+        await proxy.stop()

@@ -1,0 +1,92 @@
+"""UDP LAN discovery + self-announce (VERDICT r1 #8).
+
+Two UDP endpoints on localhost: A announces itself, B hears the addr
+packet, records A as a LAN-discovered peer keyed on the datagram's
+source address, and the dialer can then reach A.
+"""
+
+import asyncio
+
+import pytest
+
+from pybitmessage_tpu.core import Node
+from pybitmessage_tpu.network.udp import UDPDiscovery
+from pybitmessage_tpu.storage.knownnodes import Peer
+
+
+def _solver(ih, t, should_stop=None):
+    return (0, 0)
+
+
+def _make_node():
+    return Node(listen=True, solver=_solver, test_mode=True,
+                allow_private_peers=True, dandelion_enabled=False,
+                tls_enabled=False)
+
+
+@pytest.mark.asyncio
+async def test_two_nodes_discover_via_udp():
+    node_a = _make_node()
+    node_b = _make_node()
+    await node_a.start()
+    await node_b.start()
+    udp_a = UDPDiscovery(node_a.pool, port=0, bind_host="127.0.0.1",
+                         announce_interval=3600)
+    udp_b = UDPDiscovery(node_b.pool, port=0, bind_host="127.0.0.1",
+                         announce_interval=3600)
+    await udp_a.start()
+    await udp_b.start()
+    try:
+        # A shouts its addr at B's UDP endpoint (stand-in for the LAN
+        # broadcast, which containers can't route)
+        udp_a.announce(to=("127.0.0.1", udp_b.listen_port))
+        for _ in range(50):
+            if node_b.pool.lan_peers:
+                break
+            await asyncio.sleep(0.05)
+        assert node_b.pool.lan_peers, "B never heard A's announcement"
+        peer = next(iter(node_b.pool.lan_peers))
+        # the advertised port is A's TCP listen port; host comes from
+        # the datagram source
+        assert peer == Peer("127.0.0.1", node_a.pool.listen_port)
+        # >= : the announce loop also fires once at startup
+        assert udp_a.announcements_sent >= 1
+        assert udp_b.peers_heard == 1
+
+        # the discovered peer is actually dialable
+        conn = await node_b.pool.connect_to(peer)
+        assert conn is not None
+        for _ in range(100):
+            if conn.fully_established:
+                break
+            await asyncio.sleep(0.05)
+        assert conn.fully_established
+    finally:
+        await udp_a.stop()
+        await udp_b.stop()
+        await node_b.stop()
+        await node_a.stop()
+
+
+@pytest.mark.asyncio
+async def test_udp_ignores_non_addr_and_garbage():
+    node = _make_node()
+    await node.start()
+    udp = UDPDiscovery(node.pool, port=0, bind_host="127.0.0.1",
+                       announce_interval=3600)
+    await udp.start()
+    try:
+        loop = asyncio.get_running_loop()
+        transport, _ = await loop.create_datagram_endpoint(
+            asyncio.DatagramProtocol,
+            remote_addr=("127.0.0.1", udp.listen_port))
+        transport.sendto(b"garbage not a packet")
+        from pybitmessage_tpu.models.packet import pack_packet
+        transport.sendto(pack_packet("ping", b""))  # non-addr command
+        await asyncio.sleep(0.2)
+        assert udp.peers_heard == 0
+        assert not node.pool.lan_peers
+        transport.close()
+    finally:
+        await udp.stop()
+        await node.stop()
