@@ -1,12 +1,18 @@
 """API client CLI (role of the reference's bitmessagecli.py).
 
-Drives a running daemon's JSON-RPC API:
+Drives a running daemon's JSON-RPC API, either one-shot:
 
     python -m pybitmessage_tpu.cli --api-port 8442 listaddresses
     python -m pybitmessage_tpu.cli createaddress --label work
     python -m pybitmessage_tpu.cli send BM-to BM-from "subject" "body"
     python -m pybitmessage_tpu.cli inbox
-    python -m pybitmessage_tpu.cli status <ackdata-hex>
+
+or as an interactive shell (reference bitmessagecli.py's mode):
+
+    python -m pybitmessage_tpu.cli interactive
+    bm> inbox
+    bm> read <msgid>
+    bm> send BM-to BM-from "subject" "body"
 """
 
 from __future__ import annotations
@@ -15,6 +21,7 @@ import argparse
 import base64
 import http.client
 import json
+import shlex
 import sys
 
 
@@ -36,18 +43,21 @@ class RPCClient:
                 headers)
             http_resp = conn.getresponse()
             if http_resp.status == 401:
-                raise SystemExit("error: API authentication failed "
-                                 "(check --api-user/--api-password)")
+                raise CommandError("API authentication failed "
+                                   "(check --api-user/--api-password)")
             resp = json.loads(http_resp.read())
         except (ConnectionError, OSError) as exc:
-            raise SystemExit(
-                f"error: cannot reach API at {self.host}:{self.port} "
-                f"({exc})")
+            raise CommandError(
+                f"cannot reach API at {self.host}:{self.port} ({exc})")
         finally:
             conn.close()
         if "error" in resp and resp["error"]:
-            raise SystemExit(f"error: {resp['error']['message']}")
+            raise CommandError(resp["error"]["message"])
         return resp["result"]
+
+
+class CommandError(Exception):
+    pass
 
 
 def _b64(s: str) -> str:
@@ -58,91 +68,216 @@ def _unb64(s: str) -> str:
     return base64.b64decode(s).decode("utf-8", "replace")
 
 
+# --- command handlers -------------------------------------------------------
+# Each: (usage, min_args, handler(rpc, argv) -> None).  Shared verbatim by
+# the one-shot CLI, the interactive shell, and the TUI's action layer.
+
+def _h_listaddresses(rpc, argv):
+    for a in json.loads(rpc.call("listAddresses"))["addresses"]:
+        print(f"{a['address']}  [{a['label']}]"
+              + ("  (chan)" if a.get("chan") else ""))
+
+
+def _h_createaddress(rpc, argv):
+    label = argv[0] if argv else ""
+    print(rpc.call("createRandomAddress", _b64(label)))
+
+
+def _h_createdeterministic(rpc, argv):
+    out = rpc.call("createDeterministicAddresses", _b64(argv[0]), 1)
+    print(json.loads(out)["addresses"][0])
+
+
+def _h_deleteaddress(rpc, argv):
+    print(rpc.call("deleteAddress", argv[0]))
+
+
+def _h_send(rpc, argv):
+    to, sender, subject, body = argv[:4]
+    ack = rpc.call("sendMessage", to, sender, _b64(subject), _b64(body))
+    print(f"queued; ackdata = {ack}")
+
+
+def _h_broadcast(rpc, argv):
+    sender, subject, body = argv[:3]
+    ack = rpc.call("sendBroadcast", sender, _b64(subject), _b64(body))
+    print(f"queued; ackdata = {ack}")
+
+
+def _h_inbox(rpc, argv):
+    msgs = json.loads(rpc.call("getAllInboxMessages"))["inboxMessages"]
+    if not msgs:
+        print("(inbox empty)")
+    for m in msgs:
+        # full msgid so it can be passed straight to `read`/`trash`
+        flag = " " if m.get("read") else "*"
+        print(f"{flag} {m['msgid']}  {m['fromAddress']} -> "
+              f"{m['toAddress']}  {_unb64(m['subject'])!r}")
+
+
+def _h_sent(rpc, argv):
+    msgs = json.loads(rpc.call("getAllSentMessages"))["sentMessages"]
+    if not msgs:
+        print("(nothing sent)")
+    for m in msgs:
+        print(f"{m['msgid']}  -> {m['toAddress']}  "
+              f"{_unb64(m['subject'])!r}  [{m['status']}]")
+
+
+def _h_read(rpc, argv):
+    out = json.loads(rpc.call("getInboxMessageById", argv[0], True))
+    for m in out["inboxMessage"]:
+        print(f"From:    {m['fromAddress']}")
+        print(f"To:      {m['toAddress']}")
+        print(f"Subject: {_unb64(m['subject'])}")
+        print()
+        print(_unb64(m["message"]))
+
+
+def _h_status(rpc, argv):
+    print(rpc.call("getStatus", argv[0]))
+
+
+def _h_subscribe(rpc, argv):
+    label = argv[1] if len(argv) > 1 else ""
+    print(rpc.call("addSubscription", argv[0], _b64(label)))
+
+
+def _h_unsubscribe(rpc, argv):
+    print(rpc.call("deleteSubscription", argv[0]))
+
+
+def _h_subscriptions(rpc, argv):
+    for s in json.loads(rpc.call("listSubscriptions"))["subscriptions"]:
+        print(f"{s['address']}  [{_unb64(s['label'])}]")
+
+
+def _h_addressbook(rpc, argv):
+    for e in json.loads(
+            rpc.call("listAddressBookEntries"))["addresses"]:
+        print(f"{e['address']}  [{_unb64(e['label'])}]")
+
+
+def _h_addcontact(rpc, argv):
+    label = argv[1] if len(argv) > 1 else ""
+    print(rpc.call("addAddressBookEntry", argv[0], _b64(label)))
+
+
+def _h_delcontact(rpc, argv):
+    print(rpc.call("deleteAddressBookEntry", argv[0]))
+
+
+def _h_chancreate(rpc, argv):
+    print(rpc.call("createChan", _b64(argv[0])))
+
+
+def _h_chanjoin(rpc, argv):
+    print(rpc.call("joinChan", _b64(argv[0]), argv[1]))
+
+
+def _h_chanleave(rpc, argv):
+    print(rpc.call("leaveChan", argv[0]))
+
+
+def _h_trash(rpc, argv):
+    print(rpc.call("trashMessage", argv[0]))
+
+
+def _h_clientstatus(rpc, argv):
+    print(rpc.call("clientStatus"))
+
+
+def _h_shutdown(rpc, argv):
+    print(rpc.call("shutdown"))
+
+
+COMMANDS: dict[str, tuple[str, int, callable]] = {
+    "listaddresses": ("", 0, _h_listaddresses),
+    "createaddress": ("[label]", 0, _h_createaddress),
+    "createdeterministic": ("<passphrase>", 1, _h_createdeterministic),
+    "deleteaddress": ("<address>", 1, _h_deleteaddress),
+    "send": ("<to> <from> <subject> <body>", 4, _h_send),
+    "broadcast": ("<from> <subject> <body>", 3, _h_broadcast),
+    "inbox": ("", 0, _h_inbox),
+    "sent": ("", 0, _h_sent),
+    "read": ("<msgid>", 1, _h_read),
+    "status": ("<ackdata>", 1, _h_status),
+    "subscribe": ("<address> [label]", 1, _h_subscribe),
+    "unsubscribe": ("<address>", 1, _h_unsubscribe),
+    "subscriptions": ("", 0, _h_subscriptions),
+    "addressbook": ("", 0, _h_addressbook),
+    "addcontact": ("<address> [label]", 1, _h_addcontact),
+    "delcontact": ("<address>", 1, _h_delcontact),
+    "chancreate": ("<passphrase>", 1, _h_chancreate),
+    "chanjoin": ("<passphrase> <address>", 2, _h_chanjoin),
+    "chanleave": ("<address>", 1, _h_chanleave),
+    "trash": ("<msgid>", 1, _h_trash),
+    "clientstatus": ("", 0, _h_clientstatus),
+    "shutdown": ("", 0, _h_shutdown),
+}
+
+
+def run_command(rpc: RPCClient, name: str, argv: list[str]) -> None:
+    """Dispatch one command; raises CommandError on any failure."""
+    if name not in COMMANDS:
+        raise CommandError(f"unknown command {name!r} (try 'help')")
+    usage, min_args, handler = COMMANDS[name]
+    if len(argv) < min_args:
+        raise CommandError(f"usage: {name} {usage}")
+    handler(rpc, argv)
+
+
+def interactive(rpc: RPCClient) -> int:
+    """REPL mode (reference bitmessagecli.py's interactive shell)."""
+    print("pybitmessage-tpu interactive shell — 'help' lists commands, "
+          "'quit' exits")
+    while True:
+        try:
+            line = input("bm> ")
+        except (EOFError, KeyboardInterrupt):
+            print()
+            return 0
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            parts = shlex.split(line)
+        except ValueError as exc:
+            print(f"parse error: {exc}")
+            continue
+        name, argv = parts[0].lower(), parts[1:]
+        if name in ("quit", "exit"):
+            return 0
+        if name in ("help", "?"):
+            for cmd, (usage, _, _h) in sorted(COMMANDS.items()):
+                print(f"  {cmd} {usage}")
+            continue
+        try:
+            run_command(rpc, name, argv)
+        except CommandError as exc:
+            print(f"error: {exc}")
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="pybitmessage_tpu.cli")
     p.add_argument("--api-host", default="127.0.0.1")
     p.add_argument("--api-port", type=int, default=8442)
     p.add_argument("--api-user", default="")
     p.add_argument("--api-password", default="")
-    sub = p.add_subparsers(dest="command", required=True)
-
-    sub.add_parser("listaddresses")
-    ca = sub.add_parser("createaddress")
-    ca.add_argument("--label", default="")
-    ca.add_argument("--passphrase", default=None,
-                    help="deterministic address from passphrase")
-    send = sub.add_parser("send")
-    send.add_argument("to")
-    send.add_argument("sender")
-    send.add_argument("subject")
-    send.add_argument("body")
-    bc = sub.add_parser("broadcast")
-    bc.add_argument("sender")
-    bc.add_argument("subject")
-    bc.add_argument("body")
-    sub.add_parser("inbox")
-    read = sub.add_parser("read")
-    read.add_argument("msgid")
-    st = sub.add_parser("status")
-    st.add_argument("ackdata")
-    subsc = sub.add_parser("subscribe")
-    subsc.add_argument("address")
-    subsc.add_argument("--label", default="")
-    sub.add_parser("subscriptions")
-    sub.add_parser("clientstatus")
-    trash = sub.add_parser("trash")
-    trash.add_argument("msgid")
-
+    p.add_argument("command", nargs="?", default="interactive",
+                   help="one of: interactive, "
+                        + ", ".join(sorted(COMMANDS)))
+    p.add_argument("args", nargs="*")
     args = p.parse_args(argv)
     rpc = RPCClient(args.api_host, args.api_port, args.api_user,
                     args.api_password)
-
-    if args.command == "listaddresses":
-        for a in json.loads(rpc.call("listAddresses"))["addresses"]:
-            print(f"{a['address']}  [{a['label']}]"
-                  + ("  (chan)" if a.get("chan") else ""))
-    elif args.command == "createaddress":
-        if args.passphrase is not None:
-            out = rpc.call("createDeterministicAddresses",
-                           _b64(args.passphrase), 1)
-            print(json.loads(out)["addresses"][0])
-        else:
-            print(rpc.call("createRandomAddress", _b64(args.label)))
-    elif args.command == "send":
-        ack = rpc.call("sendMessage", args.to, args.sender,
-                       _b64(args.subject), _b64(args.body))
-        print(f"queued; ackdata = {ack}")
-    elif args.command == "broadcast":
-        ack = rpc.call("sendBroadcast", args.sender, _b64(args.subject),
-                       _b64(args.body))
-        print(f"queued; ackdata = {ack}")
-    elif args.command == "inbox":
-        msgs = json.loads(rpc.call("getAllInboxMessages"))["inboxMessages"]
-        if not msgs:
-            print("(inbox empty)")
-        for m in msgs:
-            # full msgid so it can be passed straight to `read`/`trash`
-            print(f"{m['msgid']}  {m['fromAddress']} -> "
-                  f"{m['toAddress']}  {_unb64(m['subject'])!r}")
-    elif args.command == "read":
-        out = json.loads(rpc.call("getInboxMessageById", args.msgid))
-        for m in out["inboxMessage"]:
-            print(f"From:    {m['fromAddress']}")
-            print(f"To:      {m['toAddress']}")
-            print(f"Subject: {_unb64(m['subject'])}")
-            print()
-            print(_unb64(m["message"]))
-    elif args.command == "status":
-        print(rpc.call("getStatus", args.ackdata))
-    elif args.command == "subscribe":
-        print(rpc.call("addSubscription", args.address, _b64(args.label)))
-    elif args.command == "subscriptions":
-        for s in json.loads(rpc.call("listSubscriptions"))["subscriptions"]:
-            print(f"{s['address']}  [{_unb64(s['label'])}]")
-    elif args.command == "clientstatus":
-        print(rpc.call("clientStatus"))
-    elif args.command == "trash":
-        print(rpc.call("trashMessage", args.msgid))
+    if args.command == "interactive":
+        return interactive(rpc)
+    try:
+        run_command(rpc, args.command, args.args)
+    except CommandError as exc:
+        print(f"error: {exc}", file=sys.stderr)
+        return 1
     return 0
 
 

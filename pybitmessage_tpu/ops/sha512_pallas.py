@@ -196,9 +196,16 @@ def pallas_search(ih_words, base, target, rows: int = 256,
     return found[:, 0], nonce
 
 
+#: measured v5e sweet spot: 84.6 MH/s honest at (256 rows, 512 chunks)
+#: = 16.7M trials/slab (~200 ms).  rows=512 exceeds the 16 MB VMEM
+#: scoped limit; chunks=1024+ fails to compile.  See BASELINE.md.
+DEFAULT_ROWS = 256
+DEFAULT_CHUNKS = 512
+
+
 def solve(initial_hash: bytes, target: int, *,
-          start_nonce: int = 0, rows: int = 256,
-          chunks_per_call: int = 16, should_stop=None,
+          start_nonce: int = 0, rows: int = DEFAULT_ROWS,
+          chunks_per_call: int = DEFAULT_CHUNKS, should_stop=None,
           interpret: bool = False):
     """Find a nonce whose trial value is <= target (Pallas backend).
 

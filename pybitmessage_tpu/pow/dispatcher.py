@@ -51,6 +51,7 @@ class PowDispatcher:
                  tpu_kwargs: dict | None = None, num_threads: int = 0):
         self.tpu_kwargs = tpu_kwargs or {}
         self._tpu_enabled = use_tpu
+        self._pallas_enabled = use_tpu
         self._native = NativeSolver(num_threads) if use_native else None
         self.last_backend = ""
         self.last_rate = 0.0
@@ -140,6 +141,13 @@ class PowDispatcher:
         self.last_rate = sum(r[1] for r in results) / dt
         return results
 
+    def _on_accelerator(self) -> bool:
+        try:
+            import jax
+            return jax.default_backend() != "cpu"
+        except Exception:
+            return False
+
     def _solve(self, initial_hash, target, start_nonce, should_stop):
         if self._tpu_enabled:
             try:
@@ -152,6 +160,23 @@ class PowDispatcher:
                         initial_hash, target, self._mesh(ndev, 1),
                         start_nonce=start_nonce, should_stop=should_stop,
                         **self.tpu_kwargs)
+                if self._pallas_enabled and self._on_accelerator():
+                    # Mosaic kernel: ~3.3x the XLA path on a v5e chip
+                    # (84.6 vs 25.8 MH/s, BASELINE.md) — the fastest
+                    # usable backend leads the ladder, reference
+                    # proofofwork.py:288-325 / openclpow wiring
+                    try:
+                        from ..ops.sha512_pallas import solve as pl_solve
+                        self.last_backend = "tpu-pallas"
+                        return pl_solve(initial_hash, target,
+                                        start_nonce=start_nonce,
+                                        should_stop=should_stop)
+                    except PowInterrupted:
+                        raise
+                    except Exception:
+                        logger.exception(
+                            "Pallas PoW failed; using XLA search")
+                        self._pallas_enabled = False
                 from ..ops.pow_search import solve as tpu_solve
                 self.last_backend = "tpu"
                 return tpu_solve(initial_hash, target,

@@ -7,19 +7,23 @@ hardware.  Must run before the first ``import jax`` anywhere.
 
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+# PYBM_TEST_PLATFORM=tpu runs the suite against the real chip instead
+# (used for the accelerator-gated tests in test_pow_pallas.py, which
+# skip themselves on the CPU mesh).
+if os.environ.get("PYBM_TEST_PLATFORM", "cpu") == "cpu":
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
 
-# The container's sitecustomize pre-registers a TPU backend at
-# interpreter start, so the env var alone is too late — force the
-# platform through the config API before any backend is initialized.
-import jax  # noqa: E402
+    # The container's sitecustomize pre-registers a TPU backend at
+    # interpreter start, so the env var alone is too late — force the
+    # platform through the config API before any backend is initialized.
+    import jax  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_platforms", "cpu")
 
 # ---------------------------------------------------------------------------
 # Minimal async test support (pytest-asyncio is not in the image): any

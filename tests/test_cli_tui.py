@@ -1,0 +1,143 @@
+"""Interactive CLI dispatch + TUI view-model rendering against a live
+node's JSON-RPC API (VERDICT r1 #9: grow toward bitmessagecli.py's
+interactive feature set and a curses-equivalent frontend)."""
+
+import asyncio
+import base64
+import io
+from contextlib import redirect_stdout
+
+import pytest
+
+from pybitmessage_tpu.api import APIServer
+from pybitmessage_tpu.cli import CommandError, RPCClient, run_command
+from pybitmessage_tpu.core import Node
+from pybitmessage_tpu.tui import PANES, ViewModel, render_frame
+
+
+def _solver(ih, t, should_stop=None):
+    from pybitmessage_tpu.pow.dispatcher import python_solve
+    return python_solve(ih, t, should_stop=should_stop)
+
+
+from contextlib import asynccontextmanager
+
+
+@asynccontextmanager
+async def live_api():
+    # conftest's minimal asyncio runner has no async-fixture support,
+    # so this is a context manager each test enters itself
+    node = Node(listen=False, solver=_solver, test_mode=True,
+                tls_enabled=False)
+    await node.start()
+    api = APIServer(node, port=0, username="u", password="p")
+    await api.start()
+    try:
+        yield node, RPCClient(port=api.listen_port, user="u", password="p")
+    finally:
+        await api.stop()
+        await node.stop()
+
+
+async def _run(rpc, name, argv=()):
+    # the RPC client is synchronous http.client; calling it on the
+    # event loop would deadlock against the in-process API server
+    def call():
+        buf = io.StringIO()
+        with redirect_stdout(buf):
+            run_command(rpc, name, list(argv))
+        return buf.getvalue()
+    return await asyncio.to_thread(call)
+
+
+@pytest.mark.asyncio
+async def test_cli_address_send_inbox_roundtrip():
+  async with live_api() as (node, rpc):
+    addr = (await _run(rpc, "createaddress", ["work"])).strip()
+    assert addr.startswith("BM-")
+    assert addr in await _run(rpc, "listaddresses")
+
+    out = await _run(rpc, "send", [addr, addr, "cli subj", "cli body"])
+    assert "ackdata" in out
+
+    for _ in range(400):
+        if node.store.inbox():
+            break
+        await asyncio.sleep(0.05)
+    inbox_out = await _run(rpc, "inbox")
+    assert "cli subj" in inbox_out
+
+    msgid = inbox_out.split()[1]
+    read_out = await _run(rpc, "read", [msgid])
+    assert "cli body" in read_out
+    assert "Subject: cli subj" in read_out
+
+    sent_out = await _run(rpc, "sent")
+    assert "ackreceived" in sent_out
+
+    await _run(rpc, "trash", [msgid])
+    assert "cli subj" not in await _run(rpc, "inbox")
+
+
+@pytest.mark.asyncio
+async def test_cli_contacts_chans_and_errors():
+  async with live_api() as (node, rpc):
+    addr = (await _run(rpc, "createaddress", ["me"])).strip()
+    await _run(rpc, "addcontact", [addr, "myself"])
+    book = await _run(rpc, "addressbook")
+    assert addr in book and "myself" in book
+    await _run(rpc, "delcontact", [addr])
+    assert addr not in await _run(rpc, "addressbook")
+
+    chan = (await _run(rpc, "chancreate", ["general"])).strip()
+    assert chan.startswith("BM-")
+    assert "(chan)" in await _run(rpc, "listaddresses")
+
+    with pytest.raises(CommandError, match="usage"):
+        await asyncio.to_thread(run_command, rpc, "send",
+                                ["only-two", "args"])
+    with pytest.raises(CommandError, match="unknown command"):
+        await asyncio.to_thread(run_command, rpc, "frobnicate", [])
+
+
+@pytest.mark.asyncio
+async def test_tui_view_model_renders_all_panes():
+  async with live_api() as (node, rpc):
+    vm = ViewModel(rpc)
+    addr = await asyncio.to_thread(vm.create_address, "tui id")
+    await asyncio.to_thread(vm.send_message, addr, addr, "tui subj",
+                            "tui body line")
+    for _ in range(400):
+        if node.store.inbox():
+            break
+        await asyncio.sleep(0.05)
+    await asyncio.to_thread(vm.refresh)
+
+    inbox_lines = vm.render_inbox(120)
+    assert any("tui subj" in ln for ln in inbox_lines)
+    assert any("tui id" in ln for ln in vm.render_addresses(120))
+    assert any("ackreceived" in ln for ln in vm.render_sent(120))
+    net = vm.render_network(120)
+    assert any("connections" in ln for ln in net)
+
+    # full message view wraps body and marks it read server-side
+    msg_lines = await asyncio.to_thread(vm.render_message, 0, 40)
+    assert any("Subject: tui subj" in ln for ln in msg_lines)
+    assert any("tui body line" in ln for ln in msg_lines)
+    await asyncio.to_thread(vm.refresh)
+    assert vm.inbox[0]["read"]
+
+    # whole-frame composition: header shows the active pane bracketed,
+    # selection marker on the chosen row
+    frame = render_frame(vm, "Inbox", 0, 120)
+    assert frame[0].startswith("[Inbox]")
+    assert all(p in frame[0] for p in PANES)
+    assert frame[2].startswith("> ")
+
+    # every pane renders without a terminal
+    for pane in PANES:
+        assert render_frame(vm, pane, 0, 80)
+
+    # narrow widths clip instead of overflowing
+    for ln in render_frame(vm, "Inbox", 0, 20):
+        assert len(ln) < 20
