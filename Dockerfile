@@ -1,0 +1,18 @@
+# Daemon container (role of the reference's Dockerfile: run the node
+# headless with a persistent data directory).
+FROM python:3.12-slim
+
+RUN apt-get update && apt-get install -y --no-install-recommends \
+        g++ make && rm -rf /var/lib/apt/lists/*
+
+WORKDIR /app
+COPY . /app
+RUN pip install --no-cache-dir jax numpy cryptography && \
+    make -C native/pow
+
+VOLUME /data
+EXPOSE 8444 8442
+
+# test-mode first boot generates config the way the reference's
+# Dockerfile runs `pybitmessage -t`
+ENTRYPOINT ["python", "-m", "pybitmessage_tpu", "-d", "/data"]

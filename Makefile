@@ -1,0 +1,20 @@
+# Build/test orchestration (role of the reference's setup.py Extension
+# build + tox targets).  The C++ solver is also auto-built at runtime by
+# pybitmessage_tpu/pow/native.py when missing or stale.
+
+.PHONY: all native test bench clean
+
+all: native
+
+native:
+	$(MAKE) -C native/pow
+
+test: native
+	python -m pytest tests/ -q
+
+bench: native
+	python bench.py
+
+clean:
+	$(MAKE) -C native/pow clean
+	find . -name __pycache__ -type d -exec rm -rf {} +

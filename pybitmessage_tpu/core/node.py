@@ -65,7 +65,10 @@ class Node:
             inventory=self.inventory, knownnodes=self.knownnodes,
             dandelion=self.dandelion, streams=(stream,), port=port,
             allow_private_peers=allow_private_peers,
-            pow_ntpb=min_ntpb, pow_extra=min_extra)
+            pow_ntpb=min_ntpb, pow_extra=min_extra,
+            # test mode keeps the announce jitter but shrinks it so
+            # multi-hop flows stay inside test timeouts
+            announce_buckets=2 if test_mode else None)
         self.pool = ConnectionPool(self.ctx)
         self.listen = listen
         if tls_enabled:

@@ -63,7 +63,8 @@ class BMConnection:
         self.outbound = outbound
         self.host = host
         self.port = port
-        self.tracker = ConnectionTracker()
+        self.tracker = ConnectionTracker(
+            buckets=getattr(self.ctx, "announce_buckets", None) or 10)
         self.services = 0
         self.streams: tuple[int, ...] = ()
         self.remote_protocol = 0
@@ -75,6 +76,10 @@ class BMConnection:
         self.last_activity = time.time()
         self._closed = False
         self.pending_upload: deque[bytes] = deque()
+        #: getdata service suppressed until this time
+        #: (antiIntersectionDelay, reference tcp.py:96-127)
+        self.skip_until = 0.0
+        self._connected_at = time.time()
         self._task: asyncio.Task | None = None
 
     # -- lifecycle -----------------------------------------------------------
@@ -239,6 +244,7 @@ class BMConnection:
                 and self.ctx.services & NODE_SSL:
             await self._upgrade_tls()
         self.fully_established = True
+        self._anti_intersection_delay(initial=True)
         await self._send_addr_sample()
         await self._send_big_inv()
         self.pool.connection_established(self)
@@ -313,10 +319,32 @@ class BMConnection:
             self.pending_upload.append(h)
         await self.flush_uploads()
 
+    def _anti_intersection_delay(self, initial: bool = False) -> None:
+        """Defense against intersection attacks (reference tcp.py:96-127):
+        pause getdata service for roughly the time a small object needs
+        to propagate network-wide, (a) right after establishment and
+        (b) whenever the peer requests an object we don't have — so an
+        attacker probing whether we originated an object gets one shot
+        per IP and an answer indistinguishable from relay timing."""
+        import math
+        nodes = max(len(self.ctx.knownnodes.peers(s) or ())
+                    for s in self.ctx.streams) if self.ctx.streams else 0
+        pending = self.tracker.pending_announcements()
+        delay = math.ceil(math.log(nodes + 2, 20)) * (0.2 + pending / 2.0)
+        if delay <= 0:
+            return
+        base = self._connected_at if initial else time.time()
+        self.skip_until = max(self.skip_until, base + delay)
+        logger.debug("%s: skipping getdata service for %.2fs%s",
+                     self.host, self.skip_until - time.time(),
+                     " (initial)" if initial else " (missing object)")
+
     async def flush_uploads(self, limit: int = 10) -> None:
         """Serve up to ``limit`` queued getdata requests
         (reference uploadthread.py:15-69).  Objects still in the
         dandelion stem phase are withheld as if unknown."""
+        if time.time() < self.skip_until:
+            return  # antiIntersectionDelay window — serve nothing yet
         dand = self.ctx.dandelion
         served = 0
         while self.pending_upload and served < limit:
@@ -329,7 +357,8 @@ class BMConnection:
             try:
                 item = self.ctx.inventory[h]
             except KeyError:
-                continue  # reference applies antiIntersectionDelay here
+                self._anti_intersection_delay()
+                continue
             await self.send_packet("object", item.payload)
             self.tracker.object_received(h)
             served += 1

@@ -58,7 +58,8 @@ class NodeContext:
                  streams=(1,), port=8444, services=1 | 8,
                  nonce: bytes | None = None,
                  allow_private_peers: bool = False,
-                 pow_ntpb: int = 1000, pow_extra: int = 1000):
+                 pow_ntpb: int = 1000, pow_extra: int = 1000,
+                 announce_buckets: int | None = None):
         self.inventory = inventory
         self.knownnodes = knownnodes
         self.dandelion = dandelion
@@ -72,6 +73,9 @@ class NodeContext:
         #: bitmessagemain.py:167-172)
         self.pow_ntpb = pow_ntpb
         self.pow_extra = pow_extra
+        #: inv/addr timing-decorrelation bucket count (MultiQueue role)
+        from .tracker import ANNOUNCE_BUCKETS
+        self.announce_buckets = announce_buckets or ANNOUNCE_BUCKETS
         #: kB/s-style global throttles (0 = unlimited), reference
         #: maxdownloadrate/maxuploadrate semantics
         self.download_bucket = TokenBucket(0)

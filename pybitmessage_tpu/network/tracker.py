@@ -11,6 +11,7 @@ so two connections don't download the same object twice
 
 from __future__ import annotations
 
+import random
 import threading
 import time
 
@@ -22,6 +23,11 @@ REQUEST_TIMEOUT = 3600
 TRACK_TIMEOUT = 3600
 #: max getdata hashes per request round (downloadthread.py:26)
 MAX_REQUEST_CHUNK = 1000
+#: announcement timing-decorrelation buckets (reference MultiQueue,
+#: multiqueue.py:16-54: items land in a random subqueue and each 1 s
+#: inv tick drains only one, so an announcement's send time carries no
+#: information about when the object arrived)
+ANNOUNCE_BUCKETS = 10
 
 
 class GlobalTracker:
@@ -59,30 +65,51 @@ class GlobalTracker:
 
 
 class ConnectionTracker:
-    """Per-connection object view."""
+    """Per-connection object view.
 
-    def __init__(self) -> None:
+    ``buckets`` controls announcement timing decorrelation: pending
+    announcements are assigned to a random bucket and each call to
+    :meth:`take_announcements` drains only the next bucket in rotation
+    (so with the pool's 1 s inv cadence an announcement leaves 0..N-1
+    seconds after it was queued, uncorrelated with arrival time).
+    ``buckets=1`` disables the jitter (tests).
+    """
+
+    def __init__(self, buckets: int = ANNOUNCE_BUCKETS) -> None:
         self.objects_new_to_me: RandomTrackingDict[bytes, bool] = \
             RandomTrackingDict()
-        self._new_to_them: dict[bytes, float] = {}
+        self.buckets = max(1, buckets)
+        self._new_to_them: list[dict[bytes, float]] = [
+            {} for _ in range(self.buckets)]
+        self._rotation = 0
         self._lock = threading.RLock()
 
     def peer_announced(self, hash_: bytes) -> None:
         """Peer inv'd this hash — it knows it; maybe we want it."""
         with self._lock:
-            self._new_to_them.pop(hash_, None)
+            for bucket in self._new_to_them:
+                bucket.pop(hash_, None)
         self.objects_new_to_me[hash_] = True
 
     def we_should_announce(self, hash_: bytes) -> None:
         with self._lock:
-            self._new_to_them[hash_] = time.time()
+            self._new_to_them[random.randrange(self.buckets)][hash_] = \
+                time.time()
 
     def take_announcements(self, limit: int = 50000) -> list[bytes]:
+        """Drain one rotation bucket (reference invthread + MultiQueue
+        iterate(), invthread.py:50-111)."""
         with self._lock:
-            out = list(self._new_to_them)[:limit]
+            bucket = self._new_to_them[self._rotation]
+            self._rotation = (self._rotation + 1) % self.buckets
+            out = list(bucket)[:limit]
             for h in out:
-                del self._new_to_them[h]
+                del bucket[h]
             return out
+
+    def pending_announcements(self) -> int:
+        with self._lock:
+            return sum(len(b) for b in self._new_to_them)
 
     def object_received(self, hash_: bytes) -> None:
         self.objects_new_to_me.pop(hash_, None)
@@ -94,5 +121,6 @@ class ConnectionTracker:
     def clean(self) -> None:
         cutoff = time.time() - TRACK_TIMEOUT
         with self._lock:
-            for h in [h for h, t in self._new_to_them.items() if t < cutoff]:
-                del self._new_to_them[h]
+            for bucket in self._new_to_them:
+                for h in [h for h, t in bucket.items() if t < cutoff]:
+                    del bucket[h]

@@ -28,6 +28,7 @@ def _make_node(listen=True, dandelion_enabled=False):
         dandelion=Dandelion(enabled=dandelion_enabled),
         port=0,
         allow_private_peers=True,  # loopback test topology
+        announce_buckets=2,        # keep inv jitter inside test timeouts
     )
     pool = ConnectionPool(ctx, listen_host="127.0.0.1")
     return ctx, pool

@@ -1,0 +1,69 @@
+"""Privacy defenses (VERDICT r1 #10): announcement timing
+decorrelation (MultiQueue role) and antiIntersectionDelay."""
+
+import asyncio
+import os
+import time
+
+import pytest
+
+from pybitmessage_tpu.network.tracker import ConnectionTracker
+from tests.test_network import _make_node, _wait_for
+from pybitmessage_tpu.storage import Peer
+
+
+def test_announce_buckets_rotate_and_decorrelate():
+    t = ConnectionTracker(buckets=3)
+    hashes = [os.urandom(32) for _ in range(60)]
+    for h in hashes:
+        t.we_should_announce(h)
+    assert t.pending_announcements() == 60
+    drains = [t.take_announcements() for _ in range(3)]
+    # everything leaves within one full rotation, split across ticks
+    assert sorted(h for d in drains for h in d) == sorted(hashes)
+    assert t.pending_announcements() == 0
+    # with 60 random placements all three buckets are (overwhelmingly)
+    # non-empty — a single tick must NOT flush everything
+    assert all(d for d in drains)
+    assert max(len(d) for d in drains) < 60
+
+
+def test_peer_announced_clears_all_buckets():
+    t = ConnectionTracker(buckets=5)
+    h = os.urandom(32)
+    t.we_should_announce(h)
+    t.peer_announced(h)  # peer already knows it: never announce back
+    assert t.pending_announcements() == 0
+    for _ in range(5):
+        assert h not in t.take_announcements()
+
+
+@pytest.mark.asyncio
+async def test_anti_intersection_delay_on_unknown_getdata():
+    from pybitmessage_tpu.network.messages import encode_inv
+
+    ctx_a, pool_a = _make_node()
+    ctx_b, pool_b = _make_node()
+    # populate knownnodes so the propagation-time estimate is nonzero
+    for i in range(50):
+        ctx_a.knownnodes.add(Peer("203.0.113.%d" % (i + 1), 8444))
+    await pool_a.start()
+    await pool_b.start(listen=False)
+    try:
+        conn = await pool_b.connect_to(Peer("127.0.0.1", pool_a.listen_port))
+        assert await _wait_for(lambda: conn.fully_established)
+        serverside = next(iter(pool_a.inbound))
+        baseline = serverside.skip_until
+
+        # request an object A has never heard of
+        await conn.send_packet("getdata", encode_inv([os.urandom(32)]))
+        assert await _wait_for(
+            lambda: serverside.skip_until > max(baseline, time.time())), \
+            "unknown-object getdata should arm the delay window"
+        # while armed, flush_uploads serves nothing
+        served_before = len(serverside.pending_upload)
+        await serverside.flush_uploads()
+        assert len(serverside.pending_upload) == served_before
+    finally:
+        await pool_b.stop()
+        await pool_a.stop()
