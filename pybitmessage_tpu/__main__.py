@@ -21,7 +21,13 @@ def build_parser() -> argparse.ArgumentParser:
         prog="pybitmessage_tpu",
         description="TPU-native Bitmessage node")
     p.add_argument("-d", "--data-dir", default=None,
-                   help="data directory (default: in-memory)")
+                   help="data directory (default: in-memory; "
+                        "--appdata uses ~/.config/pybitmessage-tpu "
+                        "or $BITMESSAGE_HOME)")
+    p.add_argument("--appdata", action="store_true",
+                   help="persist to the standard appdata directory")
+    p.add_argument("--daemon", action="store_true",
+                   help="detach from the terminal (double fork)")
     p.add_argument("-p", "--port", type=int, default=None,
                    help="P2P listen port (default from settings: 8444)")
     p.add_argument("--no-listen", action="store_true",
@@ -86,7 +92,8 @@ async def run(args) -> int:
                 test_mode=args.test_mode,
                 dandelion_enabled=settings.getint("dandelion") > 0,
                 tls_enabled=settings.getbool("tls"),
-                udp_enabled=settings.getbool("udp") and not args.no_listen)
+                udp_enabled=settings.getbool("udp") and not args.no_listen,
+                inventory_backend=settings.get("inventorystorage"))
     node.settings = settings
     node.dandelion.stem_probability = settings.getint("dandelion")
     # kB/s global throttles (reference maxdownloadrate/maxuploadrate)
@@ -110,6 +117,23 @@ async def run(args) -> int:
 
     await node.start()
 
+    upnp_client = None
+    if settings.getbool("upnp") and not args.no_listen:
+        from .network.upnp import UPnPClient
+        upnp_client = UPnPClient()
+        try:
+            await upnp_client.discover(timeout=5)
+            await upnp_client.add_port_mapping(node.pool.listen_port)
+        except Exception as exc:
+            logging.warning("UPnP port mapping unavailable: %r", exc)
+            upnp_client = None
+
+    notifier = None
+    if settings.get("apinotifypath"):
+        from .core.notify import ApiNotifier
+        notifier = ApiNotifier(node, settings.get("apinotifypath"))
+        notifier.start()
+
     api = None
     # The API is powerful (reads inboxes, sends messages); match the
     # reference's default-off-with-mandatory-auth posture: refuse to
@@ -129,6 +153,8 @@ async def run(args) -> int:
                         password=settings.get("apipassword"))
         await api.start()
         logging.info("API listening on 127.0.0.1:%d", api.listen_port)
+        if notifier is not None:
+            notifier.notify("apiEnabled")
 
     smtp_gw = None
     if settings.getbool("smtpdenabled"):
@@ -155,26 +181,88 @@ async def run(args) -> int:
             pass
     await stop.wait()
     logging.info("shutting down...")
+    if notifier is not None:
+        notifier.stop()
     if deliverer is not None:
         deliverer.stop()
     if smtp_gw is not None:
         await smtp_gw.stop()
     if api is not None:
         await api.stop()
+    if upnp_client is not None:
+        try:
+            await upnp_client.delete_port_mapping()
+        except Exception:
+            logging.debug("UPnP unmap failed", exc_info=True)
     await node.stop()
     settings.save()
     return 0
 
 
+def _setup_logging(args) -> None:
+    """Reference debug.py: a logging.dat fileConfig override wins;
+    otherwise console + rotating debug.log (2 MiB x 1) in the data
+    directory."""
+    level = logging.DEBUG if args.verbose else logging.INFO
+    if args.data_dir:
+        logging_dat = Path(args.data_dir) / "logging.dat"
+        if logging_dat.exists():
+            # aliased import: a bare `import logging.config` would bind
+            # the name `logging` function-locally and shadow the module
+            import logging.config as logging_config
+            try:
+                logging_config.fileConfig(
+                    logging_dat, disable_existing_loggers=False)
+                return
+            except Exception:
+                pass  # fall through to the default config
+    handlers: list = [logging.StreamHandler()]
+    if args.data_dir:
+        from logging.handlers import RotatingFileHandler
+        Path(args.data_dir).mkdir(parents=True, exist_ok=True)
+        handlers.append(RotatingFileHandler(
+            Path(args.data_dir) / "debug.log",
+            maxBytes=2 * 1024 * 1024, backupCount=1))
+    logging.basicConfig(
+        level=level, handlers=handlers,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s")
+
+
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
-    logging.basicConfig(
-        level=logging.DEBUG if args.verbose else logging.INFO,
-        format="%(asctime)s %(name)s %(levelname)s %(message)s")
+    _setup_logging(args)
+    logging.getLogger("jax").setLevel(logging.INFO)
+    # honor JAX_PLATFORMS even when a sitecustomize pre-registered an
+    # accelerator backend (the env var alone is applied too late there)
+    import os as _os
+    if _os.environ.get("JAX_PLATFORMS"):
+        try:
+            import jax
+            jax.config.update("jax_platforms",
+                              _os.environ["JAX_PLATFORMS"])
+        except Exception:
+            pass
+    from .core.appenv import (SingleInstance, SingleInstanceError,
+                              appdata_dir, daemonize)
+    if args.appdata and not args.data_dir:
+        args.data_dir = str(appdata_dir())
+    if args.daemon:  # pragma: no cover - forks away from test runners
+        daemonize()
+    lock = None
+    if args.data_dir:
+        lock = SingleInstance(args.data_dir)
+        try:
+            lock.acquire()
+        except SingleInstanceError as exc:
+            logging.error("%s", exc)
+            return 1
     try:
         return asyncio.run(run(args))
     except KeyboardInterrupt:  # pragma: no cover
         return 0
+    finally:
+        if lock is not None:
+            lock.release()
 
 
 if __name__ == "__main__":

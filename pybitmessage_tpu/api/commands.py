@@ -344,6 +344,12 @@ class CommandHandler:
         self.node.store.trash_sent(msgid)
         return "Trashed message (assuming message existed)."
 
+    def cmd_undeleteMessage(self, msgid_hex):
+        """Restore a trashed inbox message (reference testmode-only
+        HandleUndeleteMessage, api.py)."""
+        self.node.store.undelete_inbox(self._hex_msgid(msgid_hex))
+        return "Undeleted message (assuming message existed)."
+
     def cmd_trashInboxMessage(self, msgid_hex):
         self.node.store.trash_inbox(self._hex_msgid(msgid_hex))
         return "Trashed inbox message (assuming message existed)."
@@ -474,6 +480,20 @@ class CommandHandler:
                   if pool.inbound else
                   "connectedButHaveNotReceivedIncomingConnections"
                   if established else "notConnected")
+        # up/down speed from the global byte counters, sampled between
+        # successive clientStatus calls (reference network/stats.py:19-78
+        # over the asyncore sentBytes/receivedBytes counters)
+        import time as _time
+        rx = self.node.ctx.download_bucket.total_bytes
+        tx = self.node.ctx.upload_bucket.total_bytes
+        now = _time.monotonic()
+        last = getattr(self, "_rate_sample", None)
+        down_rate = up_rate = 0.0
+        if last is not None:
+            dt = max(now - last[0], 1e-6)
+            down_rate = (rx - last[1]) / dt
+            up_rate = (tx - last[2]) / dt
+        self._rate_sample = (now, rx, tx)
         return json.dumps({
             "networkConnections": established,
             "numberOfNetworkConnections": established,
@@ -485,6 +505,10 @@ class CommandHandler:
             "numberOfPubkeysProcessed":
                 self.node.processor.pubkeys_processed,
             "pendingDownload": self.node.ctx.global_tracker.pending_count(),
+            "bytesReceived": rx,
+            "bytesSent": tx,
+            "downloadRate": round(down_rate, 1),
+            "uploadRate": round(up_rate, 1),
             "softwareName": "pybitmessage-tpu",
             "softwareVersion": "0.1.0",
             "powBackends": getattr(self.node.solver, "backends",

@@ -1,9 +1,14 @@
-"""Minimal asyncio HTTP JSON-RPC server with basic auth.
+"""Minimal asyncio HTTP RPC server with basic auth: JSON-RPC + XML-RPC.
 
 Reference: src/api.py singleAPI — XML/JSON-RPC on 127.0.0.1:8442 with
-HTTP basic auth (api.py:437-457) and port retry.  This implementation
-speaks JSON-RPC 2.0 (apivariant=json of the reference); the request is
-``{"method": ..., "params": [...], "id": ...}`` POSTed to ``/``.
+HTTP basic auth (api.py:437-457) and port retry.  Both of the
+reference's apivariants are served on the same port, auto-detected per
+request: a JSON body is JSON-RPC 2.0 (``{"method", "params", "id"}``),
+an XML body is XML-RPC — the protocol the reference's own
+``bitmessagecli.py`` (xmlrpclib) speaks, so that client works against
+this daemon unchanged.  API errors surface as numbered
+``APIError NN: message`` strings (JSON error object / XML-RPC Fault),
+matching the reference's error vocabulary (api.py:111-153).
 """
 
 from __future__ import annotations
@@ -13,6 +18,7 @@ import base64
 import hmac
 import json
 import logging
+import xmlrpc.client
 
 from .commands import APIError, CommandHandler
 
@@ -91,6 +97,12 @@ class APIServer:
                 await self._respond(writer, 401, {"error": "unauthorized"},
                                     extra="WWW-Authenticate: Basic\r\n")
                 return
+            is_xml = body.lstrip().startswith(b"<") or \
+                "xml" in headers.get("content-type", "")
+            if is_xml:
+                xml_body = await self._dispatch_xml(body)
+                await self._respond_raw(writer, 200, xml_body, "text/xml")
+                return
             try:
                 req = json.loads(body)
             except Exception:
@@ -120,15 +132,49 @@ class APIServer:
             return {"jsonrpc": "2.0", "id": rid,
                     "error": {"code": exc.code, "message": str(exc)}}
 
+    async def _dispatch_xml(self, body: bytes) -> bytes:
+        """XML-RPC request -> methodResponse / Fault bytes.
+
+        Faults use the reference convention: numbered APIError text in
+        faultString (xmlrpclib clients see the same strings the
+        reference's SimpleXMLRPCServer returned)."""
+        try:
+            params, method = xmlrpc.client.loads(body)
+        except Exception:
+            return xmlrpc.client.dumps(
+                xmlrpc.client.Fault(1, "malformed XML-RPC request"),
+                allow_none=True).encode()
+        try:
+            result = await self.handler.dispatch(method, list(params))
+            return xmlrpc.client.dumps((result,), methodresponse=True,
+                                       allow_none=True).encode()
+        except APIError as exc:
+            return xmlrpc.client.dumps(
+                xmlrpc.client.Fault(exc.code, str(exc)),
+                allow_none=True).encode()
+        except xmlrpc.client.Fault as exc:
+            return xmlrpc.client.dumps(exc, allow_none=True).encode()
+        except Exception as exc:
+            logger.exception("XML-RPC dispatch failed")
+            return xmlrpc.client.dumps(
+                xmlrpc.client.Fault(1, repr(exc)),
+                allow_none=True).encode()
+
     @staticmethod
-    async def _respond(writer, status: int, payload: dict,
-                       extra: str = "") -> None:
-        body = json.dumps(payload).encode("utf-8")
+    async def _respond_raw(writer, status: int, body: bytes,
+                           content_type: str, extra: str = "") -> None:
         reason = {200: "OK", 400: "Bad Request", 401: "Unauthorized",
                   405: "Method Not Allowed", 413: "Payload Too Large"}
         head = (f"HTTP/1.1 {status} {reason.get(status, '')}\r\n"
-                f"Content-Type: application/json\r\n"
+                f"Content-Type: {content_type}\r\n"
                 f"Content-Length: {len(body)}\r\n"
                 f"{extra}Connection: close\r\n\r\n")
         writer.write(head.encode("latin-1") + body)
         await writer.drain()
+
+    @classmethod
+    async def _respond(cls, writer, status: int, payload: dict,
+                       extra: str = "") -> None:
+        await cls._respond_raw(writer, status,
+                               json.dumps(payload).encode("utf-8"),
+                               "application/json", extra)

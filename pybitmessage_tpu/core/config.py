@@ -64,6 +64,9 @@ DEFAULTS: dict[str, str] = {
     "namecoinrpcport": "8336",
     "namecoinrpcuser": "",
     "namecoinrpcpassword": "",
+    "inventorystorage": "sqlite",    # sqlite | filesystem
+    "smtpdusername": "",
+    "smtpdpassword": "",
     "powlanes": "131072",            # TPU search lanes per chunk
     "powchunks": "32",               # chunks per jitted call
     "minimizeonclose": "false",
@@ -106,6 +109,7 @@ VALIDATORS: dict[str, Callable[[str], bool]] = {
     "upnp": _validate_bool,
     "tls": _validate_bool,
     "apivariant": lambda v: v in ("json", "xml"),
+    "inventorystorage": lambda v: v in ("sqlite", "filesystem"),
     "sockstype": lambda v: v in ("none", "SOCKS5", "SOCKS4a"),
 }
 

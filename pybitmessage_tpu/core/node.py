@@ -39,7 +39,8 @@ class Node:
                  solver=None, dandelion_enabled: bool = True,
                  allow_private_peers: bool = False,
                  stream: int = 1, test_mode: bool = False,
-                 tls_enabled: bool = True, udp_enabled: bool = False):
+                 tls_enabled: bool = True, udp_enabled: bool = False,
+                 inventory_backend: str = "sqlite"):
         self.data_dir = Path(data_dir) if data_dir else None
         if self.data_dir:
             self.data_dir.mkdir(parents=True, exist_ok=True)
@@ -57,7 +58,13 @@ class Node:
         self.shutdown = asyncio.Event()
         self.db = Database(db_path)
         self.store = MessageStore(self.db)
-        self.inventory = Inventory(self.db)
+        if inventory_backend == "filesystem" and self.data_dir:
+            # one-file-per-object backend (reference storage/filesystem.py,
+            # the 'inventory.storage' config alternative)
+            from ..storage.fs_inventory import FilesystemInventory
+            self.inventory = FilesystemInventory(self.data_dir / "inventory")
+        else:
+            self.inventory = Inventory(self.db)
         self.keystore = KeyStore(keys_path)
         self.knownnodes = KnownNodes(nodes_path)
         self.dandelion = Dandelion(enabled=dandelion_enabled)

@@ -1,0 +1,68 @@
+"""Plugin discovery via package entry points.
+
+Reference: src/plugins/plugin.py:1-46 + setup.py:157-180 — frontends
+and integrations register under ``bitmessage.*`` entry-point groups
+(gui.menu, notification.message, notification.sound, indicator,
+desktop, proxyconfig) and the app loads the first one that imports
+cleanly.  Re-design on ``importlib.metadata`` (pkg_resources is gone
+in modern Python); the group vocabulary is kept so existing plugin
+packages port by renaming only their entry-point module.
+"""
+
+from __future__ import annotations
+
+import logging
+from importlib.metadata import entry_points
+
+logger = logging.getLogger("pybitmessage_tpu.plugins")
+
+GROUP_PREFIX = "bitmessage"
+
+#: groups the reference declares (setup.py:157-180)
+KNOWN_GROUPS = (
+    "gui.menu", "notification.message", "notification.sound",
+    "indicator", "desktop", "proxyconfig",
+)
+
+
+def iter_plugins(group: str):
+    """Yield (name, loaded object) for every plugin in a group."""
+    try:
+        eps = entry_points().select(group=f"{GROUP_PREFIX}.{group}")
+    except Exception:
+        return
+    for ep in eps:
+        try:
+            yield ep.name, ep.load()
+        except Exception:
+            logger.warning("plugin %s.%s failed to load",
+                           group, ep.name, exc_info=True)
+
+
+def get_plugin(group: str, name: str | None = None):
+    """First working plugin in a group, optionally by name
+    (reference plugin.get_plugin semantics)."""
+    for ep_name, obj in iter_plugins(group):
+        if name is None or ep_name == name:
+            return obj
+    return None
+
+
+def start_proxyconfig(settings) -> bool:
+    """Run the configured proxyconfig plugin (reference
+    helper_startup.start_proxyconfig — e.g. proxyconfig_stem launches a
+    private Tor and rewrites the socks settings).  Returns True when a
+    plugin ran successfully."""
+    ptype = settings.get("sockproxytype", "")
+    if not ptype:
+        return False
+    plugin = get_plugin("proxyconfig", ptype)
+    if plugin is None:
+        logger.warning("no proxyconfig plugin named %r", ptype)
+        return False
+    try:
+        plugin(settings)
+        return True
+    except Exception:
+        logger.exception("proxyconfig plugin %r failed", ptype)
+        return False

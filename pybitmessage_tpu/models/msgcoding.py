@@ -91,6 +91,11 @@ def _decode_extended(data: bytes) -> MessageBody:
         obj = _unpackb(out)
     except Exception as exc:
         raise DecodeError("bad msgpack payload") from exc
-    if not isinstance(obj, dict) or obj.get("") != "message":
-        raise DecodeError("unknown extended message type")
-    return MessageBody(str(obj.get("subject", "")), str(obj.get("body", "")))
+    # dispatch through the extended-type registry (whitelisted types
+    # only — reference messagetypes/constructObject)
+    from .messagetypes import MessageTypeError, construct
+    try:
+        mt = construct(obj)
+    except MessageTypeError as exc:
+        raise DecodeError(str(exc)) from exc
+    return MessageBody(mt.data.get("subject", ""), mt.data.get("body", ""))

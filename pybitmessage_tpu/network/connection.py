@@ -415,6 +415,14 @@ class BMConnection:
 
     # -- keepalive / errors --------------------------------------------------
 
+    async def cmd_portcheck(self, payload: bytes) -> None:
+        """Peer asks us to verify its advertised listen port is
+        reachable (reference bmproto.py:477-479 -> portCheckerQueue,
+        prioritized by connectionchooser.py:37-44): queue a dial back
+        to its source address + advertised port."""
+        from ..storage.knownnodes import Peer
+        self.pool.portcheck_requested(Peer(self.host, self.port))
+
     async def cmd_ping(self, payload: bytes) -> None:
         await self.send_packet("pong")
 

@@ -14,6 +14,7 @@ import logging
 import random
 import socket
 import time
+from collections import deque
 from typing import Callable, Optional
 
 from ..storage.knownnodes import Peer
@@ -117,6 +118,11 @@ class ConnectionPool:
         self.on_object: Callable | None = None  # hook for the processor
         #: LAN peers heard over UDP discovery -> last-heard time
         self.lan_peers: dict[Peer, float] = {}
+        #: (AddrEntry, due_time) queue for ongoing addr relay
+        self._addr_gossip: list = []
+        #: peers that asked us to verify their reachability
+        #: (reference portCheckerQueue) — dialed before rating choice
+        self._portcheck_queue: deque[Peer] = deque()
 
     # -- queries -------------------------------------------------------------
 
@@ -213,6 +219,11 @@ class ConnectionPool:
         if conn.outbound and not conn.fully_established:
             self.ctx.knownnodes.decrease_rating(Peer(conn.host, conn.port))
 
+    def portcheck_requested(self, peer: Peer) -> None:
+        """Queue a reachability-verification dial (cmd_portcheck)."""
+        if peer not in self._portcheck_queue:
+            self._portcheck_queue.append(peer)
+
     def lan_peer_discovered(self, peer: Peer, stream: int = 1) -> None:
         """A peer announced itself via LAN UDP broadcast — trusted more
         than gossip (we heard it from its own source address) and
@@ -273,10 +284,17 @@ class ConnectionPool:
         if len(self.outbound) >= self.max_outbound:
             return
         peer = None
+        # portcheck requests first (connectionchooser.py:37-44)
+        while self._portcheck_queue:
+            candidate = self._portcheck_queue.popleft()
+            if candidate not in [Peer(c.host, c.port)
+                                 for c in self.outbound]:
+                peer = candidate
+                break
         # 50% preference for LAN-discovered peers (connectionchooser.py)
         fresh_lan = [p for p, ts in self.lan_peers.items()
                      if time.time() - ts < 10800]
-        if fresh_lan and random.random() < 0.5:
+        if peer is None and fresh_lan and random.random() < 0.5:
             peer = random.choice(fresh_lan)
         if peer is None:
             peer = self.ctx.knownnodes.choose()
@@ -300,7 +318,47 @@ class ConnectionPool:
             except Exception:
                 logger.exception("inv loop error")
 
+    async def _flush_addr_gossip(self) -> None:
+        """Ongoing addr relay (reference addrthread.py:13-49): peers
+        newly learned since the last tick are re-advertised to every
+        established connection, each entry leaving after a random
+        sub-tick delay (the MultiQueue decorrelation)."""
+        from .messages import encode_addr, encode_host
+
+        fresh = self.ctx.knownnodes.newly_added
+        if fresh:
+            self.ctx.knownnodes.newly_added = []
+            now = time.time()
+            jitter = getattr(self.ctx, "announce_buckets", 10)
+            for peer, stream in fresh:
+                info = self.ctx.knownnodes.get(peer, stream)
+                if not info or info.get("self"):
+                    continue
+                try:
+                    encode_host(peer.host)
+                except OSError:
+                    continue  # DNS bootstrap names aren't wire-encodable
+                entry = AddrEntry(info["lastseen"], stream, 1,
+                                  peer.host, peer.port)
+                self._addr_gossip.append(
+                    (entry, now + random.uniform(0, jitter)))
+        if not self._addr_gossip:
+            return
+        now = time.time()
+        due = [e for e, d in self._addr_gossip if d <= now]
+        if not due:
+            return
+        self._addr_gossip = [(e, d) for e, d in self._addr_gossip
+                             if d > now]
+        packet = encode_addr(due)
+        for conn in self.established():
+            try:
+                await conn.send_packet("addr", packet)
+            except (ConnectionError, OSError):
+                continue
+
     async def _inv_once(self) -> None:
+        await self._flush_addr_gossip()
         dand = self.ctx.dandelion
         if dand:
             for h, stream in dand.expire_fluffed():

@@ -127,7 +127,7 @@ class PowDispatcher:
                     self.last_backend = "tpu-batch"
                     results = sharded_solve_batch(
                         items, self._mesh(ndev, len(items)),
-                        should_stop=should_stop, **self.tpu_kwargs)
+                        should_stop=should_stop, **self._xla_kwargs())
                 except PowInterrupted:
                     raise
                 except Exception:
@@ -148,6 +148,16 @@ class PowDispatcher:
         except Exception:
             return False
 
+    def _xla_kwargs(self) -> dict:
+        """Slab sizing for the XLA tier: the TPU sweet spot (2^19 x 64)
+        is minutes of work per slab for a host CPU backend, so without
+        an accelerator default to a small slab."""
+        if self.tpu_kwargs:
+            return self.tpu_kwargs
+        if not self._on_accelerator():
+            return {"lanes": 1 << 12, "chunks_per_call": 8}
+        return {}
+
     def _solve(self, initial_hash, target, start_nonce, should_stop):
         if self._tpu_enabled:
             try:
@@ -159,7 +169,7 @@ class PowDispatcher:
                     return sharded_solve(
                         initial_hash, target, self._mesh(ndev, 1),
                         start_nonce=start_nonce, should_stop=should_stop,
-                        **self.tpu_kwargs)
+                        **self._xla_kwargs())
                 if self._pallas_enabled and self._on_accelerator():
                     # Mosaic kernel: ~3.3x the XLA path on a v5e chip
                     # (84.6 vs 25.8 MH/s, BASELINE.md) — the fastest
@@ -182,7 +192,7 @@ class PowDispatcher:
                 return tpu_solve(initial_hash, target,
                                  start_nonce=start_nonce,
                                  should_stop=should_stop,
-                                 **self.tpu_kwargs)
+                                 **self._xla_kwargs())
             except PowInterrupted:
                 raise
             except Exception:

@@ -41,6 +41,9 @@ class KnownNodes:
     def __init__(self, path: str | Path | None = None,
                  max_nodes: int = DEFAULT_MAX_NODES):
         self._lock = threading.RLock()
+        #: peers first seen since the last addr-gossip flush (the
+        #: reference's addrQueue feed, addrthread.py)
+        self.newly_added: list = []
         self._path = Path(path) if path else None
         self._streams: dict[int, dict[Peer, dict]] = {1: {}}
         self.max_nodes = max_nodes
@@ -100,6 +103,7 @@ class KnownNodes:
                 "rating": 0.0,
                 "self": is_self,
             }
+            self.newly_added.append((peer, stream))
             return True
 
     def seed_defaults(self, stream: int = 1) -> None:

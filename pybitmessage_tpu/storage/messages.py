@@ -172,6 +172,11 @@ class MessageStore:
         self._db.execute(
             "UPDATE inbox SET folder='trash' WHERE msgid=?", (msgid,))
 
+    def undelete_inbox(self, msgid: bytes) -> None:
+        """Move a trashed message back (reference HandleUndeleteMessage)."""
+        self._db.execute(
+            "UPDATE inbox SET folder='inbox' WHERE msgid=?", (msgid,))
+
     def inbox_by_id(self, msgid: bytes) -> InboxMessage | None:
         rows = self._db.query(
             "SELECT msgid, toaddress, fromaddress, subject, received,"

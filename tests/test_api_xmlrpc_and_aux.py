@@ -1,0 +1,226 @@
+"""XML-RPC API variant, undeleteMessage, apinotify, extended-type
+registry, filesystem inventory backend, addr-gossip cadence, stats."""
+
+import asyncio
+import os
+import time
+import xmlrpc.client
+from contextlib import asynccontextmanager
+
+import pytest
+
+from pybitmessage_tpu.api import APIServer
+from pybitmessage_tpu.core import Node
+
+
+def _solver(ih, t, should_stop=None):
+    from pybitmessage_tpu.pow.dispatcher import python_solve
+    return python_solve(ih, t, should_stop=should_stop)
+
+
+@asynccontextmanager
+async def live_node():
+    node = Node(listen=False, solver=_solver, test_mode=True,
+                tls_enabled=False)
+    await node.start()
+    api = APIServer(node, port=0, username="u", password="p")
+    await api.start()
+    try:
+        yield node, api
+    finally:
+        await api.stop()
+        await node.stop()
+
+
+# -- XML-RPC variant ---------------------------------------------------------
+
+@pytest.mark.asyncio
+async def test_xmlrpc_speaks_reference_client_protocol():
+    """xmlrpclib (what the reference's bitmessagecli.py uses) works."""
+    async with live_node() as (node, api):
+        url = "http://u:p@127.0.0.1:%d/" % api.listen_port
+
+        def drive():
+            proxy = xmlrpc.client.ServerProxy(url)
+            assert proxy.helloWorld("a", "b") == "a-b"
+            assert proxy.add(2, 3) == 5
+            import base64
+            addr = proxy.createRandomAddress(
+                base64.b64encode(b"xml id").decode())
+            assert addr.startswith("BM-")
+            listing = proxy.listAddresses()
+            assert addr in listing
+            # numbered APIError surfaces as an xmlrpc Fault
+            try:
+                proxy.getInboxMessageById("zz")
+                raise AssertionError("expected Fault")
+            except xmlrpc.client.Fault as f:
+                assert "API Error" in f.faultString
+            return True
+
+        assert await asyncio.to_thread(drive)
+
+
+@pytest.mark.asyncio
+async def test_json_and_xml_share_one_port():
+    async with live_node() as (node, api):
+        import base64 as b64
+        import http.client
+        import json
+
+        def json_call():
+            conn = http.client.HTTPConnection("127.0.0.1", api.listen_port)
+            auth = b64.b64encode(b"u:p").decode()
+            conn.request("POST", "/", json.dumps(
+                {"method": "add", "params": [1, 2], "id": 7}),
+                {"Authorization": "Basic " + auth,
+                 "Content-Type": "application/json"})
+            return json.loads(conn.getresponse().read())
+
+        resp = await asyncio.to_thread(json_call)
+        assert resp["result"] == 3 and resp["id"] == 7
+
+
+# -- undeleteMessage ---------------------------------------------------------
+
+@pytest.mark.asyncio
+async def test_trash_and_undelete_roundtrip():
+    async with live_node() as (node, api):
+        me = node.create_identity("me")
+        await node.send_message(me.address, me.address, "s", "b", ttl=300)
+        for _ in range(400):
+            if node.store.inbox():
+                break
+            await asyncio.sleep(0.05)
+        msgid = node.store.inbox()[0].msgid
+        h = api.handler
+        await h.dispatch("trashMessage", [msgid.hex()])
+        assert not node.store.inbox()
+        await h.dispatch("undeleteMessage", [msgid.hex()])
+        assert len(node.store.inbox()) == 1
+
+
+# -- apinotify ---------------------------------------------------------------
+
+@pytest.mark.asyncio
+async def test_apinotify_executes_hook(tmp_path):
+    marker = tmp_path / "events.log"
+    hook = tmp_path / "hook.sh"
+    hook.write_text("#!/bin/sh\necho \"$1\" >> %s\n" % marker)
+    hook.chmod(0o755)
+
+    from pybitmessage_tpu.core.notify import ApiNotifier
+    node = Node(listen=False, solver=_solver, test_mode=True,
+                tls_enabled=False)
+    await node.start()
+    notifier = ApiNotifier(node, str(hook))
+    notifier.start()
+    try:
+        me = node.create_identity("me")
+        await node.send_message(me.address, me.address, "n", "b", ttl=300)
+        for _ in range(400):
+            if marker.exists() and "newMessage" in marker.read_text():
+                break
+            await asyncio.sleep(0.05)
+        events = marker.read_text().split()
+        assert "startingUp" in events
+        assert "newMessage" in events
+        assert notifier.fired[0] == "startingUp"
+    finally:
+        notifier.stop()
+        await node.stop()
+
+
+# -- extended messagetypes registry ------------------------------------------
+
+def test_messagetype_registry_whitelist():
+    from pybitmessage_tpu.models.messagetypes import (
+        MessageTypeError, construct)
+
+    mt = construct({"": "message", "subject": "s", "body": "b"})
+    assert mt.data == {"subject": "s", "body": "b"}
+    with pytest.raises(MessageTypeError, match="not enabled"):
+        construct({"": "vote", "msgid": "x", "vote": "+1"})  # disabled
+    with pytest.raises(MessageTypeError, match="not enabled"):
+        construct({"": "nosuch"})
+    with pytest.raises(MessageTypeError, match="missing required"):
+        from pybitmessage_tpu.models.messagetypes import Message
+        Message({"": "message", "subject": "only"})
+
+
+def test_extended_encoding_roundtrip_uses_registry():
+    from pybitmessage_tpu.models import msgcoding
+
+    blob = msgcoding.encode_message("subj", "body", msgcoding.EXTENDED)
+    out = msgcoding.decode_message(blob, msgcoding.EXTENDED)
+    assert (out.subject, out.body) == ("subj", "body")
+
+
+# -- filesystem inventory backend --------------------------------------------
+
+def test_filesystem_inventory_backend(tmp_path):
+    from pybitmessage_tpu.storage.fs_inventory import FilesystemInventory
+
+    inv = FilesystemInventory(tmp_path / "inv")
+    h = os.urandom(32)
+    future = int(time.time()) + 600
+    inv.add(h, 2, 1, b"payload bytes", future, b"T" * 32)
+    assert h in inv
+    item = inv[h]
+    assert (item.type, item.stream, item.payload, item.tag) == \
+        (2, 1, b"payload bytes", b"T" * 32)
+    assert inv.unexpired_hashes_by_stream(1) == [h]
+    assert [i.payload for i in inv.by_type_and_tag(2, b"T" * 32)] == \
+        [b"payload bytes"]
+
+    # survives a reopen (the index rebuilds from disk)
+    inv2 = FilesystemInventory(tmp_path / "inv")
+    assert h in inv2 and inv2[h].payload == b"payload bytes"
+
+    # expired objects vanish on clean()
+    h2 = os.urandom(32)
+    inv2.add(h2, 2, 1, b"old", int(time.time()) - 4 * 3600, b"")
+    inv2.clean()
+    assert h2 not in inv2 and h in inv2
+
+
+# -- ongoing addr gossip ------------------------------------------------------
+
+@pytest.mark.asyncio
+async def test_new_peers_gossip_to_established_connections():
+    from tests.test_network import _make_node, _wait_for
+    from pybitmessage_tpu.storage import Peer
+
+    ctx_a, pool_a = _make_node()
+    ctx_b, pool_b = _make_node()
+    await pool_a.start()
+    await pool_b.start(listen=False)
+    try:
+        conn = await pool_b.connect_to(Peer("127.0.0.1", pool_a.listen_port))
+        assert await _wait_for(lambda: conn.fully_established)
+        # A learns a fresh routable peer AFTER establishment
+        ctx_a.knownnodes.add(Peer("198.51.100.42", 8444))
+        assert await _wait_for(
+            lambda: Peer("198.51.100.42", 8444) in ctx_b.knownnodes.peers(),
+            timeout=15), "new peer never gossiped to B"
+    finally:
+        await pool_b.stop()
+        await pool_a.stop()
+
+
+# -- stats -------------------------------------------------------------------
+
+@pytest.mark.asyncio
+async def test_clientstatus_reports_traffic_counters():
+    import json
+
+    async with live_node() as (node, api):
+        node.ctx.download_bucket.total_bytes += 1000
+        node.ctx.upload_bucket.total_bytes += 500
+        s1 = json.loads(await api.handler.dispatch("clientStatus", []))
+        assert s1["bytesReceived"] >= 1000
+        assert s1["bytesSent"] >= 500
+        node.ctx.download_bucket.total_bytes += 5000
+        await asyncio.sleep(0.1)
+        s2 = json.loads(await api.handler.dispatch("clientStatus", []))
+        assert s2["downloadRate"] > 0

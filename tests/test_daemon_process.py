@@ -1,0 +1,104 @@
+"""Full-process integration: spawn the real daemon in a tempdir and
+drive it from outside (reference tier-3 tests, test_process.py:21-110 +
+test_api.py:23-37 — real process, BITMESSAGE_HOME tempdir, apinotify
+readiness signal, RPC conformance, clean SIGTERM shutdown)."""
+
+import base64
+import http.client
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+
+API_USER, API_PASS = "procuser", "procpass"
+
+
+def _rpc(port, method, *params):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+    auth = base64.b64encode(
+        f"{API_USER}:{API_PASS}".encode()).decode()
+    conn.request("POST", "/", json.dumps(
+        {"method": method, "params": list(params), "id": 1}),
+        {"Authorization": "Basic " + auth,
+         "Content-Type": "application/json"})
+    resp = json.loads(conn.getresponse().read())
+    conn.close()
+    if resp.get("error"):
+        raise AssertionError(resp["error"])
+    return resp["result"]
+
+
+def test_daemon_process_lifecycle(tmp_path):
+    home = tmp_path / "home"
+    marker = tmp_path / "events.log"
+    hook = tmp_path / "hook.sh"
+    hook.write_text("#!/bin/sh\necho \"$1\" >> %s\n" % marker)
+    hook.chmod(0o755)
+    api_port = 18450 + os.getpid() % 1000
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "pybitmessage_tpu",
+         "-d", str(home), "-t", "-p", "0", "--no-udp",
+         "--api-port", str(api_port),
+         "--api-user", API_USER, "--api-password", API_PASS,
+         "--set", "apinotifypath=%s" % hook],
+        env=env, cwd=os.path.dirname(os.path.dirname(__file__)),
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    try:
+        # wait for the apinotify 'apiEnabled' readiness signal
+        # (reference tests/apinotify_handler.py -> .api_started)
+        deadline = time.time() + 90
+        while time.time() < deadline:
+            if marker.exists() and "apiEnabled" in marker.read_text():
+                break
+            assert proc.poll() is None, "daemon died during startup"
+            time.sleep(0.3)
+        else:
+            raise AssertionError("daemon never signaled apiEnabled")
+
+        # singleinstance: a second daemon on the same home must refuse
+        second = subprocess.run(
+            [sys.executable, "-m", "pybitmessage_tpu",
+             "-d", str(home), "-t", "--no-udp", "--no-api", "-p", "0"],
+            env=env, cwd=os.path.dirname(os.path.dirname(__file__)),
+            capture_output=True, timeout=60)
+        assert second.returncode == 1
+        assert b"already holds" in second.stderr + second.stdout
+
+        # API conformance drive: identity -> self-send -> inbox
+        assert _rpc(api_port, "helloWorld", "x", "y") == "x-y"
+        addr = _rpc(api_port, "createRandomAddress",
+                    base64.b64encode(b"proc id").decode())
+        assert addr.startswith("BM-")
+        _rpc(api_port, "sendMessage", addr, addr,
+             base64.b64encode(b"proc subj").decode(),
+             base64.b64encode(b"proc body").decode())
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            inbox = json.loads(_rpc(api_port, "getAllInboxMessages"))
+            if inbox["inboxMessages"]:
+                break
+            time.sleep(0.5)
+        assert inbox["inboxMessages"], "self-send never delivered"
+        assert "newMessage" in marker.read_text()
+
+        # state persisted in the home dir + rotating log live
+        assert (home / "settings.dat").exists()
+        assert (home / "keys.dat").exists()
+        assert (home / "debug.log").stat().st_size > 0
+
+        # clean SIGTERM shutdown (reference test_process _stop_process)
+        proc.send_signal(signal.SIGTERM)
+        assert proc.wait(timeout=30) == 0
+        # lock released: a fresh daemon could start (lockfile gone)
+        assert not (home / "singleton.lock").exists()
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()

@@ -1,0 +1,171 @@
+"""App scaffolding: singleinstance lock, appdata resolution, UPnP
+against a fake gateway, namecoin lookup against a fake daemon, plugin
+registry."""
+
+import asyncio
+import json
+import os
+
+import pytest
+
+from pybitmessage_tpu.core.appenv import (
+    SingleInstance, SingleInstanceError, appdata_dir,
+)
+
+
+def test_appdata_resolution(monkeypatch, tmp_path):
+    monkeypatch.setenv("BITMESSAGE_HOME", str(tmp_path / "bmhome"))
+    assert appdata_dir() == tmp_path / "bmhome"
+    monkeypatch.delenv("BITMESSAGE_HOME")
+    monkeypatch.setenv("XDG_CONFIG_HOME", str(tmp_path / "xdg"))
+    assert appdata_dir() == tmp_path / "xdg" / "pybitmessage-tpu"
+
+
+def test_singleinstance_excludes_second_holder(tmp_path):
+    a = SingleInstance(tmp_path)
+    a.acquire()
+    try:
+        assert a.path.read_text() == str(os.getpid())
+        b = SingleInstance(tmp_path)
+        with pytest.raises(SingleInstanceError, match="already holds"):
+            b.acquire()
+    finally:
+        a.release()
+    # released: acquirable again
+    with SingleInstance(tmp_path):
+        pass
+
+
+# -- UPnP against a scripted fake gateway ------------------------------------
+
+DESCRIPTION_XML = """<?xml version="1.0"?>
+<root><device><serviceList><service>
+<serviceType>urn:schemas-upnp-org:service:WANIPConnection:1</serviceType>
+<controlURL>/ctl/ip</controlURL>
+</service></serviceList></device></root>"""
+
+
+@pytest.mark.asyncio
+async def test_upnp_discovery_and_mapping():
+    from pybitmessage_tpu.network.upnp import UPnPClient
+
+    soap_actions = []
+
+    async def http_handler(reader, writer):
+        req = await reader.readline()
+        headers = {}
+        while True:
+            line = await reader.readline()
+            if line.strip() == b"":
+                break
+            k, _, v = line.decode().partition(":")
+            headers[k.strip().lower()] = v.strip()
+        body = b""
+        n = int(headers.get("content-length", 0))
+        if n:
+            body = await reader.readexactly(n)
+        if req.startswith(b"GET"):
+            payload = DESCRIPTION_XML.encode()
+        else:
+            soap_actions.append(
+                (headers.get("soapaction", ""), body.decode()))
+            payload = b"<ok/>"
+        writer.write(b"HTTP/1.1 200 OK\r\nContent-Length: "
+                     + str(len(payload)).encode() + b"\r\n\r\n" + payload)
+        await writer.drain()
+        writer.close()
+
+    http = await asyncio.start_server(http_handler, "127.0.0.1", 0)
+    http_port = http.sockets[0].getsockname()[1]
+
+    class SSDPResponder(asyncio.DatagramProtocol):
+        def connection_made(self, transport):
+            self.transport = transport
+
+        def datagram_received(self, data, addr):
+            if b"M-SEARCH" in data:
+                self.transport.sendto(
+                    b"HTTP/1.1 200 OK\r\nLOCATION: http://127.0.0.1:"
+                    + str(http_port).encode() + b"/desc.xml\r\n\r\n", addr)
+
+    loop = asyncio.get_running_loop()
+    ssdp_transport, _ = await loop.create_datagram_endpoint(
+        SSDPResponder, local_addr=("127.0.0.1", 0))
+    ssdp_port = ssdp_transport.get_extra_info("sockname")[1]
+
+    try:
+        client = UPnPClient(ssdp_addr=("127.0.0.1", ssdp_port))
+        await client.discover(timeout=5)
+        assert client.control_url.endswith("/ctl/ip")
+        assert client.local_ip == "127.0.0.1"
+
+        ext = await client.add_port_mapping(8444)
+        assert ext == 8444
+        assert "AddPortMapping" in soap_actions[0][0]
+        assert "<NewInternalPort>8444</NewInternalPort>" in \
+            soap_actions[0][1]
+
+        await client.delete_port_mapping()
+        assert "DeletePortMapping" in soap_actions[1][0]
+    finally:
+        ssdp_transport.close()
+        http.close()
+
+
+# -- namecoin ----------------------------------------------------------------
+
+@pytest.mark.asyncio
+async def test_namecoin_lookup_resolves_bm_address():
+    from pybitmessage_tpu.gateways.namecoin import (
+        NamecoinError, NamecoinLookup)
+
+    requests = []
+
+    async def namecoind(reader, writer):
+        await reader.readline()
+        headers = {}
+        while True:
+            line = await reader.readline()
+            if line.strip() == b"":
+                break
+            k, _, v = line.decode().partition(":")
+            headers[k.strip().lower()] = v.strip()
+        body = await reader.readexactly(int(headers["content-length"]))
+        req = json.loads(body)
+        requests.append(req)
+        if req["params"] and req["params"][0] == "id/alice":
+            result = {"value": json.dumps(
+                {"bitmessage": "BM-2cTestAddressForAlice"})}
+            resp = {"result": result, "error": None}
+        else:
+            resp = {"result": None,
+                    "error": {"code": -4, "message": "name not found"}}
+        out = json.dumps(resp).encode()
+        writer.write(b"HTTP/1.1 200 OK\r\nContent-Length: "
+                     + str(len(out)).encode() + b"\r\n\r\n" + out)
+        await writer.drain()
+        writer.close()
+
+    server = await asyncio.start_server(namecoind, "127.0.0.1", 0)
+    port = server.sockets[0].getsockname()[1]
+    try:
+        nc = NamecoinLookup(host="127.0.0.1", port=port,
+                            user="u", password="p")
+        addr = await nc.lookup("alice")
+        assert addr == "BM-2cTestAddressForAlice"
+        assert requests[0]["method"] == "name_show"
+        with pytest.raises(NamecoinError, match="not found"):
+            await nc.lookup("id/nobody")
+    finally:
+        server.close()
+
+
+# -- plugins -----------------------------------------------------------------
+
+def test_plugin_registry_empty_but_queryable():
+    from pybitmessage_tpu.core.plugins import (
+        KNOWN_GROUPS, get_plugin, iter_plugins)
+
+    for group in KNOWN_GROUPS:
+        assert list(iter_plugins(group)) == []
+        assert get_plugin(group) is None
