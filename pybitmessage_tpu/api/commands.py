@@ -513,6 +513,13 @@ class CommandHandler:
             "softwareVersion": "0.1.0",
             "powBackends": getattr(self.node.solver, "backends",
                                    lambda: ["custom"])(),
+            # PoW observability (SURVEY §5: hash rate as a first-class
+            # metric; reference logs it per send, singleWorker.py:241)
+            "powBackend": getattr(self.node.solver, "last_backend", ""),
+            "powRate": round(getattr(self.node.solver, "last_rate", 0.0),
+                             1),
+            "powQueueDepth": (self.node.pow_service.queue.qsize()
+                              if self.node.pow_service else 0),
         }, indent=4)
 
     def cmd_deleteAndVacuum(self):

@@ -1,0 +1,202 @@
+"""Desktop GUI (role of the reference's bitmessageqt/).
+
+The reference's Qt4 frontend is ~9k lines of generated forms around the
+same core operations: inbox/sent lists, compose, identities, address
+book, subscriptions, network status (bitmessageqt/__init__.py).  This
+is the re-design on the stdlib toolkit (tkinter — PyQt/Kivy are not
+assumed installed): an RPC *client* like the TUI, sharing its tested
+``ViewModel`` fetch/action layer, with a notebook of panes, a reader,
+and compose/identity dialogs.  Auto-refreshes on a poll timer — the
+UISignal stream stays daemon-side; any frontend can attach/detach.
+
+Usage:  python -m pybitmessage_tpu.gui --api-port 8442
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from .cli import CommandError, RPCClient
+from .tui import ViewModel, _unb64
+
+REFRESH_MS = 3000
+
+
+class BMApp:  # pragma: no cover - needs a display; logic lives in ViewModel
+    def __init__(self, rpc: RPCClient):
+        import tkinter as tk
+        from tkinter import messagebox, ttk
+
+        self.tk = tk
+        self.ttk = ttk
+        self.messagebox = messagebox
+        self.vm = ViewModel(rpc)
+
+        self.root = tk.Tk()
+        self.root.title("pybitmessage-tpu")
+        self.root.geometry("900x560")
+
+        self.notebook = ttk.Notebook(self.root)
+        self.notebook.pack(fill="both", expand=True)
+
+        self.inbox_list = self._make_list(
+            "Inbox", ("From", "Subject"), self._open_message)
+        self.sent_list = self._make_list(
+            "Sent", ("To", "Subject", "Status"))
+        self.addr_list = self._make_list(
+            "Identities", ("Address", "Label"))
+        self.subs_list = self._make_list(
+            "Subscriptions", ("Address", "Label"))
+        self.network_text = self._make_text_pane("Network")
+
+        bar = ttk.Frame(self.root)
+        bar.pack(fill="x")
+        for label, cmd in (("New message", self.compose),
+                           ("New identity", self.new_identity),
+                           ("Trash selected", self.trash_selected),
+                           ("Refresh", self.refresh)):
+            ttk.Button(bar, text=label, command=cmd).pack(
+                side="left", padx=4, pady=4)
+        self.status = tk.StringVar(value="ready")
+        ttk.Label(bar, textvariable=self.status).pack(side="right", padx=6)
+
+    # -- widgets -------------------------------------------------------------
+
+    def _make_list(self, title, columns, on_open=None):
+        frame = self.ttk.Frame(self.notebook)
+        self.notebook.add(frame, text=title)
+        tree = self.ttk.Treeview(frame, columns=columns, show="headings")
+        for c in columns:
+            tree.heading(c, text=c)
+        tree.pack(fill="both", expand=True)
+        if on_open:
+            tree.bind("<Double-1>", lambda e: on_open())
+        return tree
+
+    def _make_text_pane(self, title):
+        frame = self.ttk.Frame(self.notebook)
+        self.notebook.add(frame, text=title)
+        text = self.tk.Text(frame, state="disabled")
+        text.pack(fill="both", expand=True)
+        return text
+
+    # -- data ----------------------------------------------------------------
+
+    def refresh(self):
+        try:
+            self.vm.refresh()
+        except CommandError as exc:
+            self.status.set(f"error: {exc}")
+            return
+        self._fill(self.inbox_list,
+                   [(m["fromAddress"], _unb64(m["subject"]))
+                    for m in self.vm.inbox])
+        self._fill(self.sent_list,
+                   [(m["toAddress"], _unb64(m["subject"]), m["status"])
+                    for m in self.vm.sent])
+        self._fill(self.addr_list,
+                   [(a["address"], a["label"]) for a in self.vm.addresses])
+        self._fill(self.subs_list,
+                   [(s["address"], _unb64(s["label"]))
+                    for s in self.vm.subscriptions])
+        self.network_text.configure(state="normal")
+        self.network_text.delete("1.0", "end")
+        self.network_text.insert(
+            "1.0", "\n".join(self.vm.render_network(120)))
+        self.network_text.configure(state="disabled")
+        self.status.set("%d inbox / %d sent" %
+                        (len(self.vm.inbox), len(self.vm.sent)))
+
+    def _fill(self, tree, rows):
+        tree.delete(*tree.get_children())
+        for row in rows:
+            tree.insert("", "end", values=row)
+
+    # -- actions -------------------------------------------------------------
+
+    def _selected_index(self, tree) -> int:
+        sel = tree.selection()
+        return tree.index(sel[0]) if sel else -1
+
+    def _open_message(self):
+        i = self._selected_index(self.inbox_list)
+        if i < 0:
+            return
+        win = self.tk.Toplevel(self.root)
+        win.title("Message")
+        text = self.tk.Text(win, width=90, height=30)
+        text.pack(fill="both", expand=True)
+        text.insert("1.0", "\n".join(self.vm.render_message(i, 90)))
+        text.configure(state="disabled")
+
+    def trash_selected(self):
+        i = self._selected_index(self.inbox_list)
+        if i >= 0:
+            self.vm.trash_inbox(i)
+            self.refresh()
+
+    def compose(self):
+        win = self.tk.Toplevel(self.root)
+        win.title("New message")
+        fields = {}
+        for row, name in enumerate(("To", "From", "Subject")):
+            self.ttk.Label(win, text=name).grid(row=row, column=0,
+                                                sticky="e")
+            e = self.ttk.Entry(win, width=70)
+            e.grid(row=row, column=1, padx=4, pady=2)
+            fields[name] = e
+        body = self.tk.Text(win, width=70, height=14)
+        body.grid(row=3, column=1, padx=4, pady=4)
+
+        def send():
+            try:
+                ack = self.vm.send_message(
+                    fields["To"].get(), fields["From"].get(),
+                    fields["Subject"].get(), body.get("1.0", "end-1c"))
+                self.status.set("queued %s…" % ack[:16])
+                win.destroy()
+                self.refresh()
+            except CommandError as exc:
+                self.messagebox.showerror("send failed", str(exc))
+
+        self.ttk.Button(win, text="Send", command=send).grid(
+            row=4, column=1, sticky="e", padx=4, pady=4)
+
+    def new_identity(self):
+        from tkinter.simpledialog import askstring
+        label = askstring("New identity", "Label:")
+        if label is None:
+            return
+        addr = self.vm.create_address(label)
+        self.status.set("created %s" % addr)
+        self.refresh()
+
+    # -- main loop -----------------------------------------------------------
+
+    def run(self) -> int:
+        self.refresh()
+
+        def tick():
+            self.refresh()
+            self.root.after(REFRESH_MS, tick)
+
+        self.root.after(REFRESH_MS, tick)
+        self.root.mainloop()
+        return 0
+
+
+def main(argv=None) -> int:  # pragma: no cover - needs a display
+    p = argparse.ArgumentParser(prog="pybitmessage_tpu.gui")
+    p.add_argument("--api-host", default="127.0.0.1")
+    p.add_argument("--api-port", type=int, default=8442)
+    p.add_argument("--api-user", default="")
+    p.add_argument("--api-password", default="")
+    args = p.parse_args(argv)
+    rpc = RPCClient(args.api_host, args.api_port, args.api_user,
+                    args.api_password)
+    return BMApp(rpc).run()
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())
