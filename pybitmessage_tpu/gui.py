@@ -109,9 +109,15 @@ class BMApp:  # pragma: no cover - needs a display; logic lives in ViewModel
                         (len(self.vm.inbox), len(self.vm.sent)))
 
     def _fill(self, tree, rows):
+        # preserve the user's selection across the auto-refresh — a
+        # blind delete-all would clear it mid-interaction
+        keep = self._selected_index(tree)
         tree.delete(*tree.get_children())
         for row in rows:
             tree.insert("", "end", values=row)
+        children = tree.get_children()
+        if 0 <= keep < len(children):
+            tree.selection_set(children[keep])
 
     # -- actions -------------------------------------------------------------
 
@@ -132,9 +138,14 @@ class BMApp:  # pragma: no cover - needs a display; logic lives in ViewModel
 
     def trash_selected(self):
         i = self._selected_index(self.inbox_list)
-        if i >= 0:
+        if i < 0:
+            return
+        try:
             self.vm.trash_inbox(i)
-            self.refresh()
+        except CommandError as exc:
+            self.status.set(f"error: {exc}")
+            return
+        self.refresh()
 
     def compose(self):
         win = self.tk.Toplevel(self.root)
@@ -168,7 +179,11 @@ class BMApp:  # pragma: no cover - needs a display; logic lives in ViewModel
         label = askstring("New identity", "Label:")
         if label is None:
             return
-        addr = self.vm.create_address(label)
+        try:
+            addr = self.vm.create_address(label)
+        except CommandError as exc:
+            self.messagebox.showerror("create failed", str(exc))
+            return
         self.status.set("created %s" % addr)
         self.refresh()
 

@@ -240,7 +240,7 @@ def solve(initial_hash: bytes, target: int, *,
         return pallas_search(ih_words, base, target_arr, rows=rows,
                              chunks=chunks_per_call, interpret=interpret)
 
-    def harvest(found_dev, nonce_dev, base_int: int):
+    def harvest(found_dev, nonce_dev):
         """Sync one slab's results; returns the winning nonce or None."""
         f = np.asarray(found_dev)
         idx = int(f.argmax())
@@ -258,11 +258,18 @@ def solve(initial_hash: bytes, target: int, *,
     # hides behind device compute on long (multi-slab) searches.
     base = start_nonce & mask64
     trials = 0
-    pending = None  # (found_dev, nonce_dev, slab_base)
+    pending = None  # (found_dev, nonce_dev)
     while True:
         if should_stop is not None and should_stop():
+            # the in-flight slab may already hold the answer — check
+            # before discarding ~16.7M trials of completed device work
+            if pending is not None:
+                trials += trials_per_slab
+                nonce = harvest(*pending)
+                if nonce is not None:
+                    return nonce, trials
             raise PowInterrupted("Pallas PoW interrupted by shutdown")
-        current = (*launch(base), base)
+        current = launch(base)
         base = (base + trials_per_slab) & mask64
         if pending is not None:
             trials += trials_per_slab
