@@ -86,7 +86,16 @@ async def run(args) -> int:
     from .storage.knownnodes import Peer
 
     settings = load_settings(args)
+    # explicit PoW slab overrides reach the solver ladder's XLA tier
+    # (the Pallas tier has its own measured sweet spot)
+    solver = None
+    if settings.is_set("powlanes") or settings.is_set("powchunks"):
+        from .pow import PowDispatcher
+        solver = PowDispatcher(tpu_kwargs={
+            "lanes": settings.getint("powlanes"),
+            "chunks_per_call": settings.getint("powchunks")})
     node = Node(args.data_dir,
+                solver=solver,
                 port=settings.getint("port"),
                 listen=not args.no_listen,
                 test_mode=args.test_mode,

@@ -532,3 +532,10 @@ class CommandHandler:
         asyncio.get_running_loop().call_soon(
             lambda: asyncio.ensure_future(self.node.stop()))
         return "done"
+
+    # -- reference alias spellings (api.py registers both casings) -----------
+    cmd_getAllInboxMessageIDs = cmd_getAllInboxMessageIds
+    cmd_getAllSentMessageIDs = cmd_getAllSentMessageIds
+    cmd_getInboxMessageByID = cmd_getInboxMessageById
+    cmd_getSentMessageByID = cmd_getSentMessageById
+    cmd_getSentMessagesBySender = cmd_getSentMessagesByAddress

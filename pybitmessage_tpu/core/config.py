@@ -171,6 +171,11 @@ class Settings:
                                 % (value, option))
         return value
 
+    def is_set(self, option: str) -> bool:
+        """True when the option was explicitly configured (file or
+        temp), as opposed to falling through to the default."""
+        return option in self._temp or option in self._file
+
     def options(self) -> dict[str, str]:
         """Effective settings (defaults overlaid by file and temp)."""
         out = dict(DEFAULTS)

@@ -1,0 +1,91 @@
+"""Resend backoff and interrupted-PoW recovery at the worker level
+(reference class_singleCleaner.py:92-106 + singleWorker.py:900-904,
+720-724 — message state lives in the sent table and survives anything).
+"""
+
+import asyncio
+import time
+
+import pytest
+
+from pybitmessage_tpu.core import Node
+from pybitmessage_tpu.ops.pow_search import PowInterrupted
+from pybitmessage_tpu.storage.messages import AWAITINGPUBKEY, MSGQUEUED
+
+
+def _solver(ih, t, should_stop=None):
+    from pybitmessage_tpu.pow.dispatcher import python_solve
+    return python_solve(ih, t, should_stop=should_stop)
+
+
+async def _wait(predicate, timeout=30.0):
+    loop = asyncio.get_running_loop()
+    deadline = loop.time() + timeout
+    while loop.time() < deadline:
+        if predicate():
+            return True
+        await asyncio.sleep(0.05)
+    return False
+
+
+@pytest.mark.asyncio
+async def test_resend_requeues_with_doubled_ttl():
+    node = Node(listen=False, solver=_solver, test_mode=True,
+                tls_enabled=False)
+    await node.start()
+    try:
+        alice = node.create_identity("alice")
+        # a recipient nobody knows: the send parks at awaitingpubkey
+        stranger = Node(listen=False, solver=_solver, test_mode=True,
+                        tls_enabled=False).create_identity("ghost")
+        ack = await node.send_message(stranger.address, alice.address,
+                                      "s", "b", ttl=600)
+        assert await _wait(
+            lambda: node.message_status(ack) == AWAITINGPUBKEY)
+        before = node.store.sent_by_ackdata(ack)
+
+        # time-travel past the retry horizon, then run the cleaner hook
+        node.db.execute("UPDATE sent SET sleeptill=? WHERE ackdata=?",
+                        (int(time.time()) - 5, ack))
+        await node.sender.resend_stale()
+        m = node.store.sent_by_ackdata(ack)
+        assert m.ttl == min(before.ttl * 2, 28 * 24 * 3600), \
+            "retry must double the TTL (capped at 28d)"
+        # the sweep re-sends: it parks at awaitingpubkey again with a
+        # fresh getpubkey object in the inventory
+        assert await _wait(
+            lambda: node.message_status(ack) == AWAITINGPUBKEY)
+    finally:
+        await node.stop()
+
+
+@pytest.mark.asyncio
+async def test_interrupted_pow_is_requeued_on_restart(tmp_path):
+    calls = {"n": 0}
+
+    def interrupting_solver(ih, t, should_stop=None):
+        calls["n"] += 1
+        raise PowInterrupted("simulated shutdown mid-solve")
+
+    node = Node(str(tmp_path), listen=False, solver=interrupting_solver,
+                test_mode=True, tls_enabled=False)
+    await node.start()
+    me = node.create_identity("me")
+    ack = await node.send_message(me.address, me.address, "s", "b",
+                                  ttl=300)
+    assert await _wait(lambda: calls["n"] > 0)
+    await node.stop()
+    # mid-PoW state persisted as doingmsgpow; a fresh boot must reset
+    # it to msgqueued and retry (reference singleWorker.py:720-724)
+    node2 = Node(str(tmp_path), listen=False, solver=_solver,
+                 test_mode=True, tls_enabled=False)
+    assert node2.store.sent_by_ackdata(ack).status in (
+        "doingmsgpow", MSGQUEUED)
+    await node2.start()
+    try:
+        assert await _wait(
+            lambda: node2.message_status(ack) == "ackreceived"), \
+            "restart must finish the interrupted send"
+        assert node2.store.inbox()[0].subject == "s"
+    finally:
+        await node2.stop()
