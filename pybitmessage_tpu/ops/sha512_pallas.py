@@ -27,6 +27,12 @@ from .u64 import U32
 
 LANE_COLS = 128
 
+#: measured v5e sweet spot: 84.6 MH/s honest at (256 rows, 512 chunks)
+#: = 16.7M trials/slab (~200 ms).  rows=512 exceeds the 16 MB VMEM
+#: scoped limit; chunks=1024+ fails to compile.  See BASELINE.md.
+DEFAULT_ROWS = 256
+DEFAULT_CHUNKS = 512
+
 
 def _pair(value: int):
     return jnp.uint32(value >> 32), jnp.uint32(value & 0xFFFFFFFF)
@@ -165,6 +171,178 @@ def _kernel(ih_ref, base_ref, target_ref, found_ref, nonce_ref, flag_ref, *,
         nonce_ref[step, 1] = wl
 
 
+def _batch_kernel(ih_ref, base_ref, target_ref, found_ref, nonce_ref,
+                  flag_ref, *, rows: int):
+    """2D grid (objects, chunks): each object owns a per-object early-
+    exit flag, so easy objects stop costing compute while hard ones
+    keep searching — the single-chip form of the (objects x
+    nonce-lanes) batch design (SURVEY §6)."""
+    obj = pl.program_id(0)
+    step = pl.program_id(1)
+    shape = (rows, LANE_COLS)
+
+    @pl.when(step == 0)
+    def _init_flag():
+        flag_ref[obj] = jnp.int32(0)
+
+    found_ref[obj, step] = jnp.int32(0)
+    nonce_ref[obj, step, 0] = jnp.uint32(0)
+    nonce_ref[obj, step, 1] = jnp.uint32(0)
+
+    @pl.when(flag_ref[obj] == 0)
+    def do_search():
+        lane = (jax.lax.broadcasted_iota(U32, shape, 0)
+                * jnp.uint32(LANE_COLS)
+                + jax.lax.broadcasted_iota(U32, shape, 1))
+        offset = jnp.uint32(step) * jnp.uint32(rows * LANE_COLS)
+        base_hi = base_ref[obj, 0]
+        base_lo = base_ref[obj, 1]
+        lo = base_lo + offset + lane
+        carry = (lo < base_lo).astype(U32)
+        hi = jnp.broadcast_to(base_hi, shape) + carry
+
+        zero = jnp.zeros(shape, dtype=U32)
+
+        def bcs(x):
+            return jnp.broadcast_to(x, shape)
+
+        w = [(hi, lo)]
+        w += [(bcs(ih_ref[obj, i, 0]), bcs(ih_ref[obj, i, 1]))
+              for i in range(8)]
+        w.append((bcs(jnp.uint32(0x80000000)), zero))
+        w += [(zero, zero)] * 5
+        w.append((zero, bcs(jnp.uint32(576))))
+        h1 = _compress(w)
+
+        w2 = list(h1)
+        w2.append((bcs(jnp.uint32(0x80000000)), zero))
+        w2 += [(zero, zero)] * 6
+        w2.append((zero, bcs(jnp.uint32(512))))
+        h2 = _compress(w2)
+        v_hi, v_lo = h2[0]
+
+        t_hi = target_ref[obj, 0]
+        t_lo = target_ref[obj, 1]
+        ok = (v_hi < t_hi) | ((v_hi == t_hi) & (v_lo <= t_lo))
+        big = jnp.int32(0x7FFFFFFF)
+        win_i = jnp.min(jnp.where(ok, lane.astype(jnp.int32), big))
+        hit = win_i != big
+        win = win_i.astype(U32)
+        found_ref[obj, step] = hit.astype(jnp.int32)
+        flag_ref[obj] = hit.astype(jnp.int32)
+        wl = base_lo + offset + win
+        wc = (wl < base_lo).astype(U32)
+        nonce_ref[obj, step, 0] = base_hi + wc
+        nonce_ref[obj, step, 1] = wl
+
+
+@functools.partial(jax.jit, static_argnames=("rows", "chunks", "interpret"))
+def pallas_batch_search(ih_words, bases, targets, rows: int = 256,
+                        chunks: int = 128, interpret: bool = False):
+    """Search B objects' nonce ranges in ONE kernel launch.
+
+    ``ih_words``: (B, 8, 2) uint32; ``bases``/``targets``: (B, 2).
+    Returns (found (B, chunks) int32, nonce (B, chunks, 2) uint32).
+    """
+    n_obj = ih_words.shape[0]
+    kernel = functools.partial(_batch_kernel, rows=rows)
+    found, nonce = pl.pallas_call(
+        kernel,
+        out_shape=(jax.ShapeDtypeStruct((n_obj, chunks), jnp.int32),
+                   jax.ShapeDtypeStruct((n_obj, chunks, 2), U32)),
+        grid=(n_obj, chunks),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+        ],
+        out_specs=(
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+        ),
+        scratch_shapes=[pltpu.SMEM((n_obj,), jnp.int32)],
+        interpret=interpret,
+    )(ih_words, bases, targets)
+    return found, nonce
+
+
+#: pad batches to this many objects per launch — one compiled program
+#: serves any batch size; always-hit targets make pad slots skip after
+#: their first chunk via the per-object flag
+BATCH_OBJS = 8
+BATCH_CHUNKS = 128
+
+
+def solve_batch(items, *, rows: int = DEFAULT_ROWS,
+                chunks_per_call: int = BATCH_CHUNKS, should_stop=None,
+                interpret: bool = False):
+    """Solve ``[(initial_hash, target), ...]`` in batched launches.
+
+    The single-chip production form of the pod-wide batch grid: up to
+    ``BATCH_OBJS`` objects share each kernel launch; solved (and pad)
+    objects flip their per-object flag and stop consuming grid steps.
+    Returns ``[(nonce, trials), ...]`` aligned with ``items``.
+    """
+    import numpy as np
+
+    from ..utils.hashes import double_sha512
+    from .pow_search import PowInterrupted
+
+    n = len(items)
+    if n == 0:
+        return []
+    results: list = [None] * n
+    mask64 = (1 << 64) - 1
+    trials_per_slab = rows * LANE_COLS * chunks_per_call
+
+    for group_start in range(0, n, BATCH_OBJS):
+        group = list(range(group_start, min(group_start + BATCH_OBJS, n)))
+        pad = BATCH_OBJS - len(group)
+        ihs = [items[i][0] for i in group] + [b"\x00" * 64] * pad
+        targets = [items[i][1] & mask64 for i in group] + [mask64] * pad
+        words = [[int.from_bytes(ih[j:j + 8], "big")
+                  for j in range(0, 64, 8)] for ih in ihs]
+        ih_words = jnp.array(
+            [[[w >> 32, w & 0xFFFFFFFF] for w in ws] for ws in words],
+            dtype=U32)
+        t_arr = jnp.array([[t >> 32, t & 0xFFFFFFFF] for t in targets],
+                          dtype=U32)
+        bases = [0] * BATCH_OBJS
+        trials = [0] * BATCH_OBJS
+        done = [i >= len(group) for i in range(BATCH_OBJS)]
+        while not all(done):
+            if should_stop is not None and should_stop():
+                raise PowInterrupted("batched Pallas PoW interrupted")
+            b_arr = jnp.array(
+                [[(b >> 32) & 0xFFFFFFFF, b & 0xFFFFFFFF] for b in bases],
+                dtype=U32)
+            found, nonce = pallas_batch_search(
+                ih_words, b_arr, t_arr, rows=rows,
+                chunks=chunks_per_call, interpret=interpret)
+            f = np.asarray(found)
+            nn = np.asarray(nonce)
+            for k in range(BATCH_OBJS):
+                if done[k]:
+                    continue
+                trials[k] += trials_per_slab
+                idx = int(f[k].argmax())
+                if f[k][idx]:
+                    val = (int(nn[k, idx, 0]) << 32) | int(nn[k, idx, 1])
+                    ih = items[group[k]][0]
+                    check = double_sha512(val.to_bytes(8, "big") + ih)
+                    if int.from_bytes(check[:8], "big") > targets[k]:
+                        raise ArithmeticError(
+                            "accelerator returned an invalid nonce")
+                    results[group[k]] = (val, trials[k])
+                    done[k] = True
+                    # pad semantics: hit instantly next launch, then skip
+                    t_arr = t_arr.at[k].set(
+                        jnp.array([0xFFFFFFFF, 0xFFFFFFFF], dtype=U32))
+                else:
+                    bases[k] = (bases[k] + trials_per_slab) & mask64
+    return results
+
+
 @functools.partial(jax.jit, static_argnames=("rows", "chunks", "interpret"))
 def pallas_search(ih_words, base, target, rows: int = 256,
                   chunks: int = 16, interpret: bool = False):
@@ -196,11 +374,6 @@ def pallas_search(ih_words, base, target, rows: int = 256,
     return found[:, 0], nonce
 
 
-#: measured v5e sweet spot: 84.6 MH/s honest at (256 rows, 512 chunks)
-#: = 16.7M trials/slab (~200 ms).  rows=512 exceeds the 16 MB VMEM
-#: scoped limit; chunks=1024+ fails to compile.  See BASELINE.md.
-DEFAULT_ROWS = 256
-DEFAULT_CHUNKS = 512
 
 
 def solve(initial_hash: bytes, target: int, *,

@@ -134,6 +134,20 @@ class PowDispatcher:
                     logger.exception(
                         "batched TPU PoW failed; falling back to "
                         "per-object solves")
+            elif self._pallas_enabled and self._on_accelerator():
+                # single chip: one Mosaic launch carries the whole
+                # batch on a 2D (objects x chunks) grid with
+                # per-object early exit
+                try:
+                    from ..ops.sha512_pallas import solve_batch
+                    self.last_backend = "tpu-pallas-batch"
+                    results = solve_batch(items, should_stop=should_stop)
+                except PowInterrupted:
+                    raise
+                except Exception:
+                    logger.exception(
+                        "batched Pallas PoW failed; falling back to "
+                        "per-object solves")
         if results is None:
             results = [self._solve(ih, t, 0, should_stop)
                        for ih, t in items]

@@ -42,3 +42,30 @@ def test_dispatcher_prefers_pallas_on_accelerator():
     assert d.last_backend == "tpu-pallas"
     check = double_sha512(nonce.to_bytes(8, "big") + ih)
     assert int.from_bytes(check[:8], "big") <= 2 ** 55
+
+
+@requires_accelerator
+def test_pallas_batch_solve():
+    from pybitmessage_tpu.ops.sha512_pallas import solve_batch
+
+    items = [(hashlib.sha512(b"batch %d" % i).digest(), 2 ** 45)
+             for i in range(3)]
+    results = solve_batch(items)
+    for (ih, target), (nonce, trials) in zip(items, results):
+        check = double_sha512(nonce.to_bytes(8, "big") + ih)
+        assert int.from_bytes(check[:8], "big") <= target
+        assert trials > 0
+
+
+@requires_accelerator
+def test_dispatcher_batches_on_single_chip():
+    from pybitmessage_tpu.pow import PowDispatcher
+
+    d = PowDispatcher(use_native=False)
+    items = [(hashlib.sha512(b"disp batch %d" % i).digest(), 2 ** 45)
+             for i in range(2)]
+    results = d.solve_batch(items)
+    assert d.last_backend == "tpu-pallas-batch"
+    for (ih, target), (nonce, _) in zip(items, results):
+        check = double_sha512(nonce.to_bytes(8, "big") + ih)
+        assert int.from_bytes(check[:8], "big") <= target
