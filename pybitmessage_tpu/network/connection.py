@@ -44,6 +44,10 @@ logger = logging.getLogger("pybitmessage_tpu.network")
 MAX_ADDR_SAMPLE = 500
 #: inv chunking for the initial big inv (tcp.py:210-253)
 BIG_INV_CHUNK = 50000
+#: max objects per connection with PoW verification still in flight —
+#: lets one peer's flood coalesce into device batches without letting
+#: it queue unbounded payloads
+VERIFY_WINDOW = 32
 
 
 class ConnectionClosed(Exception):
@@ -80,6 +84,9 @@ class BMConnection:
         #: (antiIntersectionDelay, reference tcp.py:96-127)
         self.skip_until = 0.0
         self._connected_at = time.time()
+        #: bounded in-flight object-verification pipeline (per peer)
+        self._verify_sem = asyncio.Semaphore(VERIFY_WINDOW)
+        self._verify_tasks: set[asyncio.Task] = set()
         self._task: asyncio.Task | None = None
 
     # -- lifecycle -----------------------------------------------------------
@@ -110,6 +117,12 @@ class BMConnection:
         if self._closed:
             return
         self._closed = True
+        # in-flight verifications are NOT cancelled: the payloads are
+        # fully received, and cancelling would strand their hashes in
+        # GlobalTracker.missing for an hour (no peer re-requests a
+        # hash marked in flight).  They settle within one verifier
+        # round; node shutdown resolves them by cancelling the
+        # verifier's futures instead.
         if self._task is not None and not self._task.done() and \
                 self._task is not asyncio.current_task():
             self._task.cancel()
@@ -375,15 +388,46 @@ class BMConnection:
         if header.stream not in self.ctx.streams:
             return
         if self.ctx.pow_verifier is not None:
-            # batched device verification (flood traffic amortizes into
-            # one fused launch; SURVEY §7.7)
-            ok = await self.ctx.pow_verifier.check(payload)
+            # Bounded verification pipeline: the read loop keeps parsing
+            # (up to VERIFY_WINDOW objects in flight) while the PoW
+            # checks coalesce into fused device batches in the
+            # verifier's drain task (SURVEY §7.7).  Awaiting the check
+            # inline would cap ingest at one object per device
+            # round-trip and starve the batching entirely.
+            await self._verify_sem.acquire()
+            task = asyncio.create_task(
+                self._verify_and_accept(header, payload))
+            self._verify_tasks.add(task)
+            task.add_done_callback(self._verify_task_done)
         else:
             ok = check_pow(payload, self.ctx.pow_ntpb, self.ctx.pow_extra,
                            clamp=False)
+            if not ok:
+                logger.debug("insufficient PoW from %s", self.host)
+                raise ConnectionClosed("object with insufficient PoW")
+            self._accept_object(header, payload)
+
+    def _verify_task_done(self, task: asyncio.Task) -> None:
+        self._verify_tasks.discard(task)
+        self._verify_sem.release()
+        if task.cancelled():
+            return
+        exc = task.exception()
+        if exc is not None:
+            # the inline path would have logged a traceback and closed
+            # the connection; keep that visibility for pipelined objects
+            logger.error("object acceptance failed on %s:%s",
+                         self.host, self.port, exc_info=exc)
+
+    async def _verify_and_accept(self, header, payload: bytes) -> None:
+        ok = await self.ctx.pow_verifier.check(payload)
         if not ok:
             logger.debug("insufficient PoW from %s", self.host)
-            raise ConnectionClosed("object with insufficient PoW")
+            await self.close()
+            return
+        self._accept_object(header, payload)
+
+    def _accept_object(self, header, payload: bytes) -> None:
         h = inventory_hash(payload)
         self.tracker.object_received(h)
         self.ctx.global_tracker.received(h)

@@ -108,10 +108,57 @@ def _broadcast_pair(pair, shape):
     return (jnp.broadcast_to(pair[0], shape), jnp.broadcast_to(pair[1], shape))
 
 
+def _search_step(ih_pair, base_hi, base_lo, target_hi, target_lo,
+                 step, rows: int):
+    """One grid step's search over a (rows, 128) nonce tile.
+
+    ``ih_pair(i) -> (hi, lo)`` abstracts the initial-hash indexing so
+    the single-object and batched kernels share this body exactly.
+    Returns (hit int32, nonce_hi, nonce_lo).
+    """
+    shape = (rows, LANE_COLS)
+    lane = (jax.lax.broadcasted_iota(U32, shape, 0)
+            * jnp.uint32(LANE_COLS)
+            + jax.lax.broadcasted_iota(U32, shape, 1))
+    offset = jnp.uint32(step) * jnp.uint32(rows * LANE_COLS)
+    lo = base_lo + offset + lane
+    carry = (lo < base_lo).astype(U32)  # offset+lane < 2^32 per slab
+    hi = jnp.broadcast_to(base_hi, shape) + carry
+
+    zero = jnp.zeros(shape, dtype=U32)
+
+    def bcs(x):
+        return jnp.broadcast_to(x, shape)
+
+    w = [(hi, lo)]
+    w += [(bcs(ih_pair(i)[0]), bcs(ih_pair(i)[1])) for i in range(8)]
+    w.append((bcs(jnp.uint32(0x80000000)), zero))
+    w += [(zero, zero)] * 5
+    w.append((zero, bcs(jnp.uint32(576))))
+    h1 = _compress(w)
+
+    w2 = list(h1)
+    w2.append((bcs(jnp.uint32(0x80000000)), zero))
+    w2 += [(zero, zero)] * 6
+    w2.append((zero, bcs(jnp.uint32(512))))
+    h2 = _compress(w2)
+    v_hi, v_lo = h2[0]
+
+    ok = (v_hi < target_hi) | ((v_hi == target_hi) & (v_lo <= target_lo))
+    # winner = smallest lane index with a hit.  Mosaic has no unsigned
+    # reductions; lane < 2^31 so int32 min is safe.
+    big = jnp.int32(0x7FFFFFFF)
+    win_i = jnp.min(jnp.where(ok, lane.astype(jnp.int32), big))
+    hit = (win_i != big).astype(jnp.int32)
+    win = win_i.astype(U32)
+    wl = base_lo + offset + win
+    wc = (wl < base_lo).astype(U32)
+    return hit, base_hi + wc, wl
+
+
 def _kernel(ih_ref, base_ref, target_ref, found_ref, nonce_ref, flag_ref, *,
             rows: int):
     step = pl.program_id(0)
-    shape = (rows, LANE_COLS)
 
     @pl.when(step == 0)
     def _init_flag():
@@ -125,50 +172,14 @@ def _kernel(ih_ref, base_ref, target_ref, found_ref, nonce_ref, flag_ref, *,
 
     @pl.when(flag_ref[0] == 0)
     def do_search():
-        lane = (jax.lax.broadcasted_iota(U32, shape, 0)
-                * jnp.uint32(LANE_COLS)
-                + jax.lax.broadcasted_iota(U32, shape, 1))
-        offset = jnp.uint32(step) * jnp.uint32(rows * LANE_COLS)
-        base_hi = base_ref[0]
-        base_lo = base_ref[1]
-        lo = base_lo + offset + lane
-        carry = (lo < base_lo).astype(U32)  # offset+lane < 2^32 per slab
-        hi = jnp.broadcast_to(base_hi, shape) + carry
-
-        zero = jnp.zeros(shape, dtype=U32)
-
-        def bcs(x):
-            return jnp.broadcast_to(x, shape)
-
-        w = [(hi, lo)]
-        w += [(bcs(ih_ref[i, 0]), bcs(ih_ref[i, 1])) for i in range(8)]
-        w.append((bcs(jnp.uint32(0x80000000)), zero))
-        w += [(zero, zero)] * 5
-        w.append((zero, bcs(jnp.uint32(576))))
-        h1 = _compress(w)
-
-        w2 = list(h1)
-        w2.append((bcs(jnp.uint32(0x80000000)), zero))
-        w2 += [(zero, zero)] * 6
-        w2.append((zero, bcs(jnp.uint32(512))))
-        h2 = _compress(w2)
-        v_hi, v_lo = h2[0]
-
-        t_hi = target_ref[0]
-        t_lo = target_ref[1]
-        ok = (v_hi < t_hi) | ((v_hi == t_hi) & (v_lo <= t_lo))
-        # winner = smallest lane index with a hit.  Mosaic has no
-        # unsigned reductions; lane < 2^31 so int32 min is safe.
-        big = jnp.int32(0x7FFFFFFF)
-        win_i = jnp.min(jnp.where(ok, lane.astype(jnp.int32), big))
-        hit = win_i != big
-        win = win_i.astype(U32)
-        found_ref[step, 0] = hit.astype(jnp.int32)
-        flag_ref[0] = hit.astype(jnp.int32)
-        wl = base_lo + offset + win
-        wc = (wl < base_lo).astype(U32)
-        nonce_ref[step, 0] = base_hi + wc
-        nonce_ref[step, 1] = wl
+        hit, n_hi, n_lo = _search_step(
+            lambda i: (ih_ref[i, 0], ih_ref[i, 1]),
+            base_ref[0], base_ref[1], target_ref[0], target_ref[1],
+            step, rows)
+        found_ref[step, 0] = hit
+        flag_ref[0] = hit
+        nonce_ref[step, 0] = n_hi
+        nonce_ref[step, 1] = n_lo
 
 
 def _batch_kernel(ih_ref, base_ref, target_ref, found_ref, nonce_ref,
@@ -176,10 +187,10 @@ def _batch_kernel(ih_ref, base_ref, target_ref, found_ref, nonce_ref,
     """2D grid (objects, chunks): each object owns a per-object early-
     exit flag, so easy objects stop costing compute while hard ones
     keep searching — the single-chip form of the (objects x
-    nonce-lanes) batch design (SURVEY §6)."""
+    nonce-lanes) batch design (SURVEY §6).  The search body is shared
+    with the single-object kernel (_search_step)."""
     obj = pl.program_id(0)
     step = pl.program_id(1)
-    shape = (rows, LANE_COLS)
 
     @pl.when(step == 0)
     def _init_flag():
@@ -191,49 +202,14 @@ def _batch_kernel(ih_ref, base_ref, target_ref, found_ref, nonce_ref,
 
     @pl.when(flag_ref[obj] == 0)
     def do_search():
-        lane = (jax.lax.broadcasted_iota(U32, shape, 0)
-                * jnp.uint32(LANE_COLS)
-                + jax.lax.broadcasted_iota(U32, shape, 1))
-        offset = jnp.uint32(step) * jnp.uint32(rows * LANE_COLS)
-        base_hi = base_ref[obj, 0]
-        base_lo = base_ref[obj, 1]
-        lo = base_lo + offset + lane
-        carry = (lo < base_lo).astype(U32)
-        hi = jnp.broadcast_to(base_hi, shape) + carry
-
-        zero = jnp.zeros(shape, dtype=U32)
-
-        def bcs(x):
-            return jnp.broadcast_to(x, shape)
-
-        w = [(hi, lo)]
-        w += [(bcs(ih_ref[obj, i, 0]), bcs(ih_ref[obj, i, 1]))
-              for i in range(8)]
-        w.append((bcs(jnp.uint32(0x80000000)), zero))
-        w += [(zero, zero)] * 5
-        w.append((zero, bcs(jnp.uint32(576))))
-        h1 = _compress(w)
-
-        w2 = list(h1)
-        w2.append((bcs(jnp.uint32(0x80000000)), zero))
-        w2 += [(zero, zero)] * 6
-        w2.append((zero, bcs(jnp.uint32(512))))
-        h2 = _compress(w2)
-        v_hi, v_lo = h2[0]
-
-        t_hi = target_ref[obj, 0]
-        t_lo = target_ref[obj, 1]
-        ok = (v_hi < t_hi) | ((v_hi == t_hi) & (v_lo <= t_lo))
-        big = jnp.int32(0x7FFFFFFF)
-        win_i = jnp.min(jnp.where(ok, lane.astype(jnp.int32), big))
-        hit = win_i != big
-        win = win_i.astype(U32)
-        found_ref[obj, step] = hit.astype(jnp.int32)
-        flag_ref[obj] = hit.astype(jnp.int32)
-        wl = base_lo + offset + win
-        wc = (wl < base_lo).astype(U32)
-        nonce_ref[obj, step, 0] = base_hi + wc
-        nonce_ref[obj, step, 1] = wl
+        hit, n_hi, n_lo = _search_step(
+            lambda i: (ih_ref[obj, i, 0], ih_ref[obj, i, 1]),
+            base_ref[obj, 0], base_ref[obj, 1],
+            target_ref[obj, 0], target_ref[obj, 1], step, rows)
+        found_ref[obj, step] = hit
+        flag_ref[obj] = hit
+        nonce_ref[obj, step, 0] = n_hi
+        nonce_ref[obj, step, 1] = n_lo
 
 
 @functools.partial(jax.jit, static_argnames=("rows", "chunks", "interpret"))

@@ -145,9 +145,13 @@ class PowDispatcher:
                 except PowInterrupted:
                     raise
                 except Exception:
+                    # latch off like the per-object ladder: a broken
+                    # Mosaic kernel must not re-pay a ~75 s failed
+                    # compile on every subsequent batch
                     logger.exception(
                         "batched Pallas PoW failed; falling back to "
                         "per-object solves")
+                    self._pallas_enabled = False
         if results is None:
             results = [self._solve(ih, t, 0, should_stop)
                        for ih, t in items]

@@ -53,6 +53,12 @@ class BatchVerifier:
                 await self._task
             except asyncio.CancelledError:
                 pass
+        # settle any still-queued checks so pipelined per-connection
+        # verification tasks waiting on them finish instead of hanging
+        while not self.queue.empty():
+            _, fut = self.queue.get_nowait()
+            if not fut.done():
+                fut.cancel()
 
     async def check(self, object_bytes: bytes) -> bool:
         """True when the object's embedded PoW meets the target."""
@@ -74,10 +80,16 @@ class BatchVerifier:
 
     async def _run(self) -> None:
         while True:
-            first = await self.queue.get()
-            if self.window > 0:
-                await asyncio.sleep(self.window)
-            batch = [first]
+            batch = []
+            try:
+                batch.append(await self.queue.get())
+                if self.window > 0:
+                    await asyncio.sleep(self.window)
+            except asyncio.CancelledError:
+                for _, fut in batch:
+                    if not fut.done():
+                        fut.cancel()
+                raise
             while not self.queue.empty():
                 batch.append(self.queue.get_nowait())
             results = None

@@ -59,3 +59,55 @@ async def test_batch_verifier_single_takes_host_path():
         assert v.device_checked == 0
     finally:
         await v.stop()
+
+
+@pytest.mark.asyncio
+async def test_flood_sync_uses_device_batches():
+    """30 objects flood from A to B in one big-inv sync; B's verifier
+    coalesces the arrivals into fused device batches."""
+    from pybitmessage_tpu.core import Node
+    from pybitmessage_tpu.storage import Peer
+    from pybitmessage_tpu.models.objects import serialize_object
+    from pybitmessage_tpu.utils.hashes import inventory_hash, sha512
+
+    def make_object(i: int) -> bytes:
+        ttl = 600
+        expires = int(time.time()) + ttl
+        obj = serialize_object(expires, 2, 1, 1, b"flood payload %d" % i)
+        target = pow_target(len(obj), ttl, NTPB, EXTRA, clamp=False)
+        nonce, _ = python_solve(sha512(obj[8:]), target)
+        return struct.pack(">Q", nonce) + obj[8:]
+
+    def solver(ih, t, should_stop=None):
+        return python_solve(ih, t, should_stop=should_stop)
+
+    node_a = Node(listen=True, solver=solver, test_mode=True,
+                  allow_private_peers=True, tls_enabled=False,
+                  dandelion_enabled=False)
+    node_b = Node(listen=True, solver=solver, test_mode=True,
+                  allow_private_peers=True, tls_enabled=False,
+                  dandelion_enabled=False)
+    for i in range(30):
+        payload = make_object(i)
+        expires = int.from_bytes(payload[8:16], "big")
+        node_a.inventory.add(inventory_hash(payload), 2, 1, payload,
+                             expires)
+    await node_a.start()
+    await node_b.start()
+    try:
+        conn = await node_b.pool.connect_to(
+            Peer("127.0.0.1", node_a.pool.listen_port))
+        deadline = asyncio.get_running_loop().time() + 60
+        while asyncio.get_running_loop().time() < deadline:
+            if len(node_b.inventory.unexpired_hashes_by_stream(1)) >= 30:
+                break
+            await asyncio.sleep(0.1)
+        assert len(node_b.inventory.unexpired_hashes_by_stream(1)) == 30, \
+            "big-inv flood never fully synced"
+        v = node_b.pow_verifier
+        assert v.device_checked + v.host_checked >= 30
+        assert v.device_batches >= 1, \
+            "flood arrivals should coalesce into device batches"
+    finally:
+        await node_b.stop()
+        await node_a.stop()
