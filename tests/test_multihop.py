@@ -1,0 +1,102 @@
+"""Multi-hop flood propagation and chan messaging across nodes.
+
+The reference never tests real multi-node topologies (SURVEY §4 calls
+this its weakest spot); these close that gap: objects must relay
+A -> B -> C through the gossip cadence, and a chan (shared
+deterministic identity) must decrypt on every member node.
+"""
+
+import asyncio
+
+import pytest
+
+from pybitmessage_tpu.core import Node
+from pybitmessage_tpu.storage import Peer
+
+
+def _solver(ih, t, should_stop=None):
+    from pybitmessage_tpu.pow.dispatcher import python_solve
+    return python_solve(ih, t, should_stop=should_stop)
+
+
+def _make_node():
+    return Node(listen=True, solver=_solver, test_mode=True,
+                allow_private_peers=True, dandelion_enabled=False,
+                tls_enabled=False)
+
+
+async def _wait(predicate, timeout=90.0):
+    loop = asyncio.get_running_loop()
+    deadline = loop.time() + timeout
+    while loop.time() < deadline:
+        if predicate():
+            return True
+        await asyncio.sleep(0.1)
+    return False
+
+
+async def _connect(dialer, listener):
+    conn = await dialer.pool.connect_to(
+        Peer("127.0.0.1", listener.pool.listen_port))
+    assert conn is not None
+    assert await _wait(lambda: conn.fully_established, 15)
+    return conn
+
+
+@pytest.mark.asyncio
+async def test_object_relays_across_three_nodes():
+    """A chain topology A-B-C: an object sent on A reaches C, which has
+    no direct connection to A, via B's re-announcement."""
+    a, b, c = _make_node(), _make_node(), _make_node()
+    for n in (a, b, c):
+        await n.start()
+    try:
+        await _connect(b, a)
+        await _connect(c, b)
+
+        alice = a.create_identity("alice")
+        await a.send_message(alice.address, alice.address,
+                             "hop hop", "relayed body", ttl=600)
+        assert await _wait(
+            lambda: len(a.inventory.unexpired_hashes_by_stream(1)) == 1)
+        the_hash = a.inventory.unexpired_hashes_by_stream(1)[0]
+        assert await _wait(lambda: the_hash in b.inventory), \
+            "object never reached B"
+        assert await _wait(lambda: the_hash in c.inventory), \
+            "object never relayed B -> C"
+    finally:
+        for n in (c, b, a):
+            await n.stop()
+
+
+@pytest.mark.asyncio
+async def test_chan_message_decrypts_on_remote_member():
+    """Two nodes join the same chan from one passphrase; a chan message
+    sent on A lands in B's inbox (chan key = deterministic identity,
+    reference class_addressGenerator joinChan semantics)."""
+    a, b = _make_node(), _make_node()
+    await a.start()
+    await b.start()
+    try:
+        chan_a = a.create_identity("[chan] testers",
+                                   deterministic=b"testers", chan=True)
+        chan_b = b.create_identity("[chan] testers",
+                                   deterministic=b"testers", chan=True)
+        assert chan_a.address == chan_b.address, \
+            "same passphrase must derive the same chan address"
+
+        await _connect(b, a)
+        sender = a.create_identity("poster")
+        await a.send_message(chan_a.address, sender.address,
+                             "chan subj", "chan body", ttl=600)
+        # A owns the chan too -> loopback inbox; B must decrypt the
+        # flooded object with the shared chan key
+        assert await _wait(lambda: any(
+            m.subject == "chan subj" for m in b.store.inbox())), \
+            "chan message never decrypted on the remote member"
+        msg = [m for m in b.store.inbox() if m.subject == "chan subj"][0]
+        assert msg.toaddress == chan_b.address
+        assert msg.message == "chan body"
+    finally:
+        await b.stop()
+        await a.stop()
