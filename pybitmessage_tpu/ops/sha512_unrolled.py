@@ -1,11 +1,18 @@
 """Fully-unrolled double-SHA512 trial — static schedule, no gathers.
 
 The fori_loop variant (sha512_jax.py) pays for dynamic W-window
-indexing (gather + scatter per round) and keeps a large carry alive
-across iterations.  Unrolling all 80 rounds with the message-schedule
-window as a Python list turns the whole trial into straight-line
-vector code: K constants fold into immediates and the window becomes
-pure register renaming.  ~3x faster on TPU at the same lane count.
+indexing and keeps a large carry alive across iterations; unrolling
+all 80 rounds with the message-schedule window as a Python list turns
+the whole trial into straight-line vector code (K constants fold into
+immediates, the window becomes pure register renaming).
+
+Status (measured, round 2): the TPU toolchain cannot compile this
+~3200-op straight-line XLA graph in useful time (>9 min vs ~7 s for
+the windowed kernel), so it is NOT the TPU default — the same unrolled
+schedule ships as the production *Pallas* kernel instead, which Mosaic
+compiles in ~75 s and runs at 3.3x the windowed rate (BASELINE.md).
+This XLA form remains selectable via ``variant="unrolled"`` for CPU
+and future toolchains, and is correctness-tested on the CPU mesh.
 """
 
 from __future__ import annotations

@@ -99,3 +99,31 @@ def test_verify_accepts_solved_nonce():
     nonce, _ = solve(initial_hash, target, lanes=512, chunks_per_call=8)
     assert verify([(nonce, initial_hash, target)]) == [True]
     assert verify([(nonce + 1, initial_hash, 1)]) == [False]
+
+
+def test_unrolled_variant_matches_hashlib_and_windowed():
+    """The static-schedule XLA variant (variant="unrolled") computes the
+    same trial values as hashlib and the windowed production kernel —
+    kept correct even though TPU compile cost keeps it off that path
+    (see sha512_unrolled module docstring / BASELINE.md)."""
+    import hashlib
+
+    import jax.numpy as jnp
+
+    from pybitmessage_tpu.ops.sha512_jax import (
+        initial_hash_words, trial_values)
+    from pybitmessage_tpu.ops.u64 import u64_from_int, u64_to_int
+
+    ih = hashlib.sha512(b"unrolled parity").digest()
+    ih_hi, ih_lo = initial_hash_words(ih)
+    b_hi, b_lo = u64_from_int(7_000_000_123)
+    (u_hi, u_lo), (n_hi, n_lo) = trial_values(
+        b_hi, b_lo, ih_hi, ih_lo, 16, "unrolled")
+    (w_hi, w_lo), _ = trial_values(b_hi, b_lo, ih_hi, ih_lo, 16, "windowed")
+    assert jnp.array_equal(u_hi, w_hi) and jnp.array_equal(u_lo, w_lo)
+    for k in range(16):
+        nonce = u64_to_int(n_hi[k], n_lo[k])
+        expect = hashlib.sha512(hashlib.sha512(
+            nonce.to_bytes(8, "big") + ih).digest()).digest()
+        assert u64_to_int(u_hi[k], u_lo[k]) == int.from_bytes(
+            expect[:8], "big")
