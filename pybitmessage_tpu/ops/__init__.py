@@ -7,11 +7,15 @@ src/proofofwork.py:104-107).  TPU vector units have no native uint64, so
 all 64-bit words are modelled as (hi, lo) uint32 pairs and the search is
 vectorized over a wide lane axis feeding the VPU.
 
-- ``u64``          — (hi, lo) uint32-pair arithmetic.
-- ``sha512_jax``   — batched one-block SHA-512 compression + the 72-byte
-                     double-SHA512 PoW trial.
-- ``pow_search``   — single-device chunked nonce search with early exit,
-                     and batched PoW verification.
+- ``u64``            — (hi, lo) uint32-pair arithmetic.
+- ``sha512_jax``     — batched one-block SHA-512 compression + the
+                       72-byte double-SHA512 PoW trial ("windowed").
+- ``sha512_unrolled``— static-schedule XLA variant (CPU/testing).
+- ``sha512_pallas``  — the production Mosaic kernel: VMEM-resident
+                       unrolled schedule, SMEM early exit, single and
+                       multi-object grids, double-buffered solve.
+- ``pow_search``     — XLA chunked nonce search with early exit, and
+                       batched PoW verification.
 """
 
 from .u64 import (  # noqa: F401
