@@ -115,6 +115,23 @@ def _h_inbox(rpc, argv):
               f"{m['toAddress']}  {_unb64(m['subject'])!r}")
 
 
+def _h_search(rpc, argv):
+    """Case-insensitive search over subject/body/addresses (role of the
+    reference's helper_search used by its UIs)."""
+    needle = argv[0].lower()
+    msgs = json.loads(rpc.call("getAllInboxMessages"))["inboxMessages"]
+    hits = [m for m in msgs
+            if needle in _unb64(m["subject"]).lower()
+            or needle in _unb64(m["message"]).lower()
+            or needle in m["fromAddress"].lower()
+            or needle in m["toAddress"].lower()]
+    if not hits:
+        print("(no matches)")
+    for m in hits:
+        print(f"{m['msgid']}  {m['fromAddress']} -> "
+              f"{m['toAddress']}  {_unb64(m['subject'])!r}")
+
+
 def _h_sent(rpc, argv):
     msgs = json.loads(rpc.call("getAllSentMessages"))["sentMessages"]
     if not msgs:
@@ -199,6 +216,7 @@ COMMANDS: dict[str, tuple[str, int, callable]] = {
     "send": ("<to> <from> <subject> <body>", 4, _h_send),
     "broadcast": ("<from> <subject> <body>", 3, _h_broadcast),
     "inbox": ("", 0, _h_inbox),
+    "search": ("<text>", 1, _h_search),
     "sent": ("", 0, _h_sent),
     "read": ("<msgid>", 1, _h_read),
     "status": ("<ackdata>", 1, _h_status),

@@ -141,3 +141,18 @@ async def test_tui_view_model_renders_all_panes():
     # narrow widths clip instead of overflowing
     for ln in render_frame(vm, "Inbox", 0, 20):
         assert len(ln) < 20
+
+
+@pytest.mark.asyncio
+async def test_cli_search():
+  async with live_api() as (node, rpc):
+    addr = (await _run(rpc, "createaddress", ["me"])).strip()
+    await _run(rpc, "send", [addr, addr, "needle subject", "haystack"])
+    await _run(rpc, "send", [addr, addr, "other", "contains needle too"])
+    for _ in range(400):
+        if len(node.store.inbox()) == 2:
+            break
+        await asyncio.sleep(0.05)
+    out = await _run(rpc, "search", ["NEEDLE"])
+    assert out.count("\n") == 2  # both messages match, one line each
+    assert "(no matches)" in await _run(rpc, "search", ["zzz-nothing"])
