@@ -198,7 +198,10 @@ class Settings:
             return
         self._path.parent.mkdir(parents=True, exist_ok=True)
         cfg = configparser.ConfigParser()
-        cfg[SECTION] = dict(self._file)
+        # Always persist settingsversion (reference always stamps it) so
+        # a fresh install's file re-enters the migration chain correctly.
+        cfg[SECTION] = {"settingsversion": str(SETTINGS_VERSION),
+                        **self._file}
         if self._path.exists():
             bak = self._path.with_name(
                 self._path.name + "." + time.strftime("%Y%m%d-%H%M%S")
@@ -216,16 +219,26 @@ class Settings:
 
     def _migrate(self) -> None:
         """Versioned upgrade chain (reference helper_startup.updateConfig)."""
-        try:
-            version = int(self._file.get("settingsversion",
-                                         str(SETTINGS_VERSION)))
-        except ValueError:
+        stamped = "settingsversion" in self._file
+        if self._file and not stamped:
+            # A non-empty file lacking the key predates version stamping:
+            # enter the chain at 1 so no migration is silently skipped.
             version = 1
+        else:
+            try:
+                version = int(self._file.get("settingsversion",
+                                             str(SETTINGS_VERSION)))
+            except ValueError:
+                version = 1
         dirty = False
         if version < 2:
-            # v1 -> v2: dandelion option introduced; old installs ran
-            # with stem routing off
-            self._file.setdefault("dandelion", "0")
+            # v1 -> v2: dandelion option introduced; explicitly-stamped
+            # v1 installs ran with stem routing off, so preserve that.
+            # Unstamped files may simply predate stamping (older save()
+            # never wrote the key) and always had the default (90) in
+            # effect — forcing 0 on them would regress behavior.
+            if stamped:
+                self._file.setdefault("dandelion", "0")
             version = 2
             dirty = True
         if dirty:

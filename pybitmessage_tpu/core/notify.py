@@ -12,6 +12,8 @@ from __future__ import annotations
 import asyncio
 import logging
 
+from ..utils.tasks import spawn
+
 logger = logging.getLogger("pybitmessage_tpu.notify")
 
 #: UISignal command -> apinotify event name
@@ -47,10 +49,9 @@ class ApiNotifier:
     def notify(self, event: str) -> None:
         self.fired.append(event)
         try:
-            loop = asyncio.get_running_loop()
+            spawn(self._spawn(event))
         except RuntimeError:
-            return
-        loop.create_task(self._spawn(event))
+            return  # no running loop (sync-context callers)
 
     async def _spawn(self, event: str) -> None:
         try:

@@ -20,6 +20,8 @@ from email.mime.text import MIMEText
 
 from .smtp_server import SMTP_DOMAIN
 
+from ..utils.tasks import spawn
+
 logger = logging.getLogger("pybitmessage_tpu.smtp")
 
 
@@ -53,8 +55,7 @@ class SMTPDeliverer:
         if command != "displayNewInboxMessage":
             return
         _, to_address, from_address, subject, body = data
-        asyncio.get_running_loop().create_task(
-            self._deliver(to_address, from_address, subject, body))
+        spawn(self._deliver(to_address, from_address, subject, body))
 
     async def _deliver(self, to_address: str, from_address: str,
                        subject: str, body: str) -> None:

@@ -19,6 +19,8 @@ import hmac
 import logging
 import re
 
+from ..utils.tasks import spawn
+
 logger = logging.getLogger("pybitmessage_tpu.smtp")
 
 SMTP_DOMAIN = "bmaddr.lan"     # reference class_smtpServer.py:24
@@ -201,9 +203,8 @@ class SMTPGateway:
                 from ..utils.addresses import decode_address
                 decode_address(local)      # validate before queuing
                 # cap TTL at 2 days (class_smtpServer.py:106-108)
-                asyncio.get_running_loop().create_task(
-                    self.node.send_message(local, sender, subject, body,
-                                           ttl=2 * 86400))
+                spawn(self.node.send_message(local, sender, subject, body,
+                                             ttl=2 * 86400))
                 queued += 1
                 self.relayed += 1
             except Exception:

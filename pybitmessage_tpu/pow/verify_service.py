@@ -18,6 +18,8 @@ import asyncio
 import logging
 import time
 
+from ..models.constants import (DEFAULT_EXTRA_BYTES,
+                                DEFAULT_NONCE_TRIALS_PER_BYTE)
 from ..models.pow_math import check_pow, pow_target
 
 logger = logging.getLogger("pybitmessage_tpu.pow")
@@ -29,8 +31,11 @@ class BatchVerifier:
     def __init__(self, *, ntpb: int = 0, extra: int = 0,
                  clamp: bool = True, window: float = 0.0,
                  min_device_batch: int = 4, use_device: bool = True):
-        self.ntpb = ntpb
-        self.extra = extra
+        # Normalize 0 -> network defaults so the device path
+        # (pow_target) and the host path (check_pow, which substitutes
+        # defaults itself) agree — and never divide by zero.
+        self.ntpb = ntpb or DEFAULT_NONCE_TRIALS_PER_BYTE
+        self.extra = extra or DEFAULT_EXTRA_BYTES
         self.clamp = clamp
         self.window = window
         self.min_device_batch = min_device_batch

@@ -65,6 +65,29 @@ def test_settings_migration_from_v1(tmp_path):
     assert s.getint("dandelion") == 0  # v1->v2 migration default
 
 
+def test_settings_fresh_save_stamps_version(tmp_path):
+    """A fresh install's file must carry settingsversion so future
+    migrations can key off it (the reference always persists it)."""
+    p = tmp_path / "settings.dat"
+    s = Settings(p)
+    s.set("port", 9001)
+    s.save()
+    assert ("settingsversion = %d" % SETTINGS_VERSION) in p.read_text()
+
+
+def test_settings_unversioned_file_treated_as_v1(tmp_path):
+    """A non-empty file lacking settingsversion predates stamping and
+    must re-enter the migration chain — but the dandelion backfill only
+    applies to explicitly-stamped v1 files (an unstamped file may come
+    from an older save() that simply never wrote the key, and always ran
+    with the default 90 in effect)."""
+    p = tmp_path / "settings.dat"
+    p.write_text("[bitmessagesettings]\nport = 8555\n")
+    s = Settings(p)
+    assert s.getint("settingsversion") == SETTINGS_VERSION
+    assert s.getint("dandelion") == 90  # default preserved, not forced 0
+
+
 def test_settings_all_defaults_valid():
     from pybitmessage_tpu.core.config import VALIDATORS
     for opt, val in DEFAULTS.items():
