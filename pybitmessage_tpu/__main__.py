@@ -105,6 +105,7 @@ async def run(args) -> int:
                 inventory_backend=settings.get("inventorystorage"))
     node.settings = settings
     node.dandelion.stem_probability = settings.getint("dandelion")
+    node.processor.list_mode = settings.get("blackwhitelist")
     # kB/s global throttles (reference maxdownloadrate/maxuploadrate)
     node.ctx.download_bucket.rate = settings.getint("maxdownloadrate") * 1024
     node.ctx.upload_bucket.rate = settings.getint("maxuploadrate") * 1024

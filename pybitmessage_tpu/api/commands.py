@@ -123,7 +123,23 @@ class CommandHandler:
         return a + b
 
     def cmd_statusBar(self, message):
-        return None  # no GUI yet; accepted for conformance
+        self.node.ui.emit("updateStatusBar", (_from_b64(message, 22),))
+        return None
+
+    def cmd_getStatusBar(self):
+        """Testmode helper (reference api.py @testmode('getStatusBar')):
+        last updateStatusBar text pushed through the UI signaler."""
+        for command, data in reversed(self.node.ui.recent):
+            if command == "updateStatusBar" and data:
+                return data[0]
+        return ""
+
+    def cmd_clearUISignalQueue(self):
+        """Testmode helper: drop buffered UI events (the reference
+        empties its UISignalQueue; our analog is the recent-events
+        ring frontends replay on attach)."""
+        self.node.ui.recent.clear()
+        return "success"
 
     # -- addresses -----------------------------------------------------------
 
@@ -141,6 +157,10 @@ class CommandHandler:
                 "stream": ident.stream, "enabled": ident.enabled,
                 "chan": ident.chan})
         return json.dumps({"addresses": out}, indent=4)
+
+    # reference api.py registers listAddresses2 as an alias of
+    # listAddresses (@command('listAddresses', 'listAddresses2'))
+    cmd_listAddresses2 = cmd_listAddresses
 
     def cmd_createRandomAddress(self, label, eighteenByteRipe=False,
                                 *_ignored):
@@ -266,6 +286,111 @@ class CommandHandler:
         decode_address(address)
         self.node.store.addressbook_delete(address)
         return "Deleted address book entry for %s" % address
+
+    # -- black/whitelist (extension) -----------------------------------------
+    # The reference manages these tables only through the Qt GUI
+    # (bitmessageqt/blacklist.py over the blacklist/whitelist SQL
+    # tables); our frontends are out-of-process RPC clients, so the
+    # same operations are exposed as API extensions.
+
+    def _listing(self, which):
+        rows = [{"label": _b64(label), "address": address,
+                 "enabled": enabled}
+                for label, address, enabled in self.node.store.listing(which)]
+        return json.dumps({which: rows}, indent=4)
+
+    def cmd_listBlacklistEntries(self):
+        return self._listing("blacklist")
+
+    def cmd_listWhitelistEntries(self):
+        return self._listing("whitelist")
+
+    def _listing_add(self, which, address, label):
+        decode_address(address)
+        if not self.node.store.listing_add(which, address,
+                                           _from_b64(label, 17)):
+            raise APIError(16, "%s already in %s" % (address, which))
+        return "Added %s to %s" % (address, which)
+
+    def cmd_addBlacklistEntry(self, address, label):
+        return self._listing_add("blacklist", address, label)
+
+    def cmd_addWhitelistEntry(self, address, label):
+        return self._listing_add("whitelist", address, label)
+
+    def cmd_deleteBlacklistEntry(self, address):
+        self.node.store.listing_delete("blacklist", address)
+        return "Deleted blacklist entry for %s" % address
+
+    def cmd_deleteWhitelistEntry(self, address):
+        self.node.store.listing_delete("whitelist", address)
+        return "Deleted whitelist entry for %s" % address
+
+    def cmd_getBlackWhitelistMode(self):
+        return self.node.processor.list_mode
+
+    def cmd_setBlackWhitelistMode(self, mode):
+        if mode not in ("black", "white"):
+            raise APIError(23, "mode must be 'black' or 'white'")
+        self.node.processor.list_mode = mode
+        settings = getattr(self.node, "settings", None)
+        if settings is not None:
+            settings.set("blackwhitelist", mode)
+            settings.save()
+        return "success"
+
+    # -- settings (extension) ------------------------------------------------
+    # The reference's settings dialog edits keys.dat in-process
+    # (bitmessageqt/settings.py over BMConfigParser); the RPC analog
+    # lets an attached GUI read and persist daemon settings.
+
+    def _settings(self):
+        settings = getattr(self.node, "settings", None)
+        if settings is None:
+            from ..core.config import Settings
+            settings = self.node.settings = Settings()
+        return settings
+
+    def cmd_getSettings(self):
+        s = self._settings()
+        # never hand secrets back out — drop every credential-bearing
+        # option (api, socks, smtpd, namecoin, and any future *password*)
+        out = {k: v for k, v in s.options().items()
+               if "password" not in k}
+        out["powBackends"] = getattr(self.node.solver, "backends",
+                                     lambda: [])()
+        return json.dumps(out, indent=4)
+
+    def cmd_updateSetting(self, key, value):
+        from ..core.config import DEFAULTS, SettingsError
+        s = self._settings()
+        if key not in DEFAULTS:
+            # Settings.set would happily persist a typo'd option name
+            # and the caller would believe it took effect
+            raise APIError(20, "unknown setting %r" % key)
+        try:
+            s.set(key, value)
+        except SettingsError as exc:
+            raise APIError(23, str(exc))
+        s.save()
+        self._apply_live_setting(key, value)
+        return "success"
+
+    def _apply_live_setting(self, key, value):
+        """Settings that can take effect without a restart do."""
+        node = self.node
+        if key == "maxdownloadrate":
+            node.ctx.download_bucket.rate = int(value) * 1024
+        elif key == "maxuploadrate":
+            node.ctx.upload_bucket.rate = int(value) * 1024
+        elif key == "maxoutboundconnections":
+            node.pool.max_outbound = int(value)
+        elif key == "maxtotalconnections":
+            node.pool.max_total = int(value)
+        elif key == "dandelion":
+            node.dandelion.stem_probability = int(value)
+        elif key == "blackwhitelist":
+            node.processor.list_mode = value
 
     # -- inbox / sent --------------------------------------------------------
 

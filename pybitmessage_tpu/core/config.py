@@ -69,6 +69,7 @@ DEFAULTS: dict[str, str] = {
     "smtpdpassword": "",
     "powlanes": "131072",            # TPU search lanes per chunk
     "powchunks": "32",               # chunks per jitted call
+    "blackwhitelist": "black",       # inbound sender policy
     "minimizeonclose": "false",
     "replybelow": "false",
     "timeformat": "%c",
@@ -111,6 +112,7 @@ VALIDATORS: dict[str, Callable[[str], bool]] = {
     "apivariant": lambda v: v in ("json", "xml"),
     "inventorystorage": lambda v: v in ("sqlite", "filesystem"),
     "sockstype": lambda v: v in ("none", "SOCKS5", "SOCKS4a"),
+    "blackwhitelist": lambda v: v in ("black", "white"),
 }
 
 

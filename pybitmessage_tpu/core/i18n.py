@@ -1,0 +1,145 @@
+"""Translation shim: gettext-style catalogs without a Qt dependency.
+
+Plays the role of the reference's ``tr.py`` (a ``_translate(context,
+text)`` that works with or without Qt) and ``l10n.py`` (locale
+formatting), backed by plain ``.po`` catalogs under
+``pybitmessage_tpu/locale/<lang>.po``.  The ``.po`` files are parsed
+directly — no compiled ``.mo`` step, no build tooling — so adding a
+language is dropping one text file.
+
+Usage::
+
+    from pybitmessage_tpu.core.i18n import tr, install
+    install("de")           # or install() to honor $LANG
+    print(tr("Inbox"))      # -> "Posteingang"
+
+``tr`` falls back to the source string for unknown keys or languages,
+so the framework is always usable untranslated.
+"""
+
+from __future__ import annotations
+
+import locale
+import os
+import time
+from pathlib import Path
+
+LOCALE_DIR = Path(__file__).resolve().parent.parent / "locale"
+
+_catalog: dict[str, str] = {}
+_language = "en"
+
+
+def parse_po(text: str) -> dict[str, str]:
+    """Minimal ``.po`` parser: msgid/msgstr pairs with multi-line
+    string continuation; comments and headers (empty msgid) skipped."""
+    entries: dict[str, str] = {}
+    msgid: list[str] | None = None
+    msgstr: list[str] | None = None
+    current: list[str] | None = None
+
+    def flush():
+        if msgid is not None and msgstr is not None:
+            key = "".join(msgid)
+            val = "".join(msgstr)
+            if key and val:
+                entries[key] = val
+
+    for raw in text.splitlines():
+        line = raw.strip()
+        if not line or line.startswith("#"):
+            continue
+        if line.startswith("msgid "):
+            flush()
+            msgid = [_unquote(line[6:])]
+            msgstr = None
+            current = msgid
+        elif line.startswith("msgstr "):
+            msgstr = [_unquote(line[7:])]
+            current = msgstr
+        elif line.startswith('"') and current is not None:
+            current.append(_unquote(line))
+    flush()
+    return entries
+
+
+_ESCAPES = {"n": "\n", "t": "\t", '"': '"', "\\": "\\"}
+
+
+def _unquote(chunk: str) -> str:
+    chunk = chunk.strip()
+    if chunk.startswith('"') and chunk.endswith('"'):
+        chunk = chunk[1:-1]
+    # single left-to-right pass: sequential str.replace corrupts a
+    # literal backslash followed by n/t (e.g. PO-escaped "C:\\network")
+    out = []
+    i = 0
+    while i < len(chunk):
+        ch = chunk[i]
+        if ch == "\\" and i + 1 < len(chunk):
+            out.append(_ESCAPES.get(chunk[i + 1], "\\" + chunk[i + 1]))
+            i += 2
+        else:
+            out.append(ch)
+            i += 1
+    return "".join(out)
+
+
+def available_languages() -> list[str]:
+    """Languages with a shipped catalog (plus implicit 'en')."""
+    langs = {"en"}
+    if LOCALE_DIR.is_dir():
+        for p in LOCALE_DIR.glob("*.po"):
+            langs.add(p.stem)
+    return sorted(langs)
+
+
+def install(lang: str | None = None) -> str:
+    """Load the catalog for ``lang`` (default: $LANGUAGE/$LANG, like
+    gettext).  Returns the language actually installed."""
+    global _catalog, _language
+    if lang is None:
+        env = os.environ.get("LANGUAGE") or os.environ.get("LANG") or "en"
+        lang = env.split(":")[0].split(".")[0].split("_")[0]
+    path = LOCALE_DIR / (lang + ".po")
+    if lang != "en" and path.is_file():
+        _catalog = parse_po(path.read_text(encoding="utf-8"))
+        _language = lang
+    else:
+        _catalog = {}
+        _language = "en"
+    return _language
+
+
+def language() -> str:
+    return _language
+
+
+def tr(text: str, /, **kwargs) -> str:
+    """Translate ``text``; unknown keys fall back to the source string.
+    Keyword arguments are ``str.format``-interpolated after lookup so
+    catalogs can reorder placeholders."""
+    out = _catalog.get(text, text)
+    if kwargs:
+        try:
+            out = out.format(**kwargs)
+        except (KeyError, IndexError):  # malformed catalog entry
+            out = text.format(**kwargs)
+    return out
+
+
+def format_timestamp(ts: float | int, fmt: str = "%c") -> str:
+    """Locale-aware timestamp rendering (the reference's l10n.py
+    formatTimestamp: user-configurable strftime with safe fallback)."""
+    try:
+        return time.strftime(fmt, time.localtime(ts))
+    except (ValueError, OverflowError, OSError):
+        return time.strftime("%c", time.localtime(ts))
+
+
+def system_encoding() -> str:
+    """Preferred terminal encoding (l10n.py's encoding probe)."""
+    try:
+        return locale.getpreferredencoding(False) or "utf-8"
+    except Exception:  # pragma: no cover - locale DB broken
+        return "utf-8"

@@ -49,9 +49,10 @@ class ApiNotifier:
     def notify(self, event: str) -> None:
         self.fired.append(event)
         try:
-            spawn(self._spawn(event))
+            asyncio.get_running_loop()
         except RuntimeError:
             return  # no running loop (sync-context callers)
+        spawn(self._spawn(event))
 
     async def _spawn(self, event: str) -> None:
         try:

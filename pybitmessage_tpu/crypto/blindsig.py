@@ -1,0 +1,215 @@
+"""ECC blind signatures over secp256k1.
+
+Capability parity with the reference's ``pyelliptic/eccblind.py`` /
+``eccblindchain.py`` (an ECC blind-signature scheme + a vouching chain,
+unit-tested but unused by the core message flow).  This is NOT a port:
+instead of the reference's ctypes-OpenSSL ECDSA-style construction this
+implements the textbook **blind Schnorr** protocol, which needs only
+group arithmetic — provided by a small pure-Python secp256k1 (this is
+a cold administrative path; the hot crypto stays in ``crypto/ecies.py``
+on the ``cryptography`` library).
+
+Protocol (all mod the curve order n, G the base point, H = sha256):
+
+- Signer: secret ``x``, public ``X = xG``; per-signature nonce ``r``,
+  sends ``R = rG``.
+- Requester blinds: picks ``α, β``; ``R' = R + αG + βX``;
+  ``c' = H(R' ‖ m)``; sends ``c = c' + β``.
+- Signer signs blind: ``s = r + c·x``, sends ``s``.
+- Requester unblinds: ``s' = s + α``.  Signature is ``(R', s')``.
+- Verify: ``s'·G == R' + H(R' ‖ m)·X``.
+
+The signer never sees ``m`` or the final signature; the requester
+cannot forge without ``x``.  ``SignatureChain`` mirrors the reference's
+eccblindchain role: a root key vouches for intermediate keys which sign
+leaf messages, each link blind-signable.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import secrets
+from dataclasses import dataclass
+
+# secp256k1 domain parameters (SEC 2)
+P = 0xFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFEFFFFFC2F
+N = 0xFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFEBAAEDCE6AF48A03BBFD25E8CD0364141
+GX = 0x79BE667EF9DCBBAC55A06295CE870B07029BFCDB2DCE28D959F2815B16F81798
+GY = 0x483ADA7726A3C4655DA4FBFC0E1108A8FD17B448A68554199C47D08FFB10D4B8
+
+_INF = None          # point at infinity
+
+
+def _inv(a: int, m: int) -> int:
+    return pow(a, -1, m)
+
+
+def _add(p1, p2):
+    if p1 is _INF:
+        return p2
+    if p2 is _INF:
+        return p1
+    x1, y1 = p1
+    x2, y2 = p2
+    if x1 == x2:
+        if (y1 + y2) % P == 0:
+            return _INF
+        lam = (3 * x1 * x1) * _inv(2 * y1, P) % P
+    else:
+        lam = (y2 - y1) * _inv(x2 - x1, P) % P
+    x3 = (lam * lam - x1 - x2) % P
+    return x3, (lam * (x1 - x3) - y1) % P
+
+
+def _mul(k: int, point=(GX, GY)):
+    k %= N
+    acc, addend = _INF, point
+    while k:
+        if k & 1:
+            acc = _add(acc, addend)
+        addend = _add(addend, addend)
+        k >>= 1
+    return acc
+
+
+def _encode_point(point) -> bytes:
+    if point is _INF:
+        return b"\x00"
+    x, y = point
+    return bytes([2 + (y & 1)]) + x.to_bytes(32, "big")
+
+
+def _decode_point(data: bytes):
+    if data == b"\x00":
+        return _INF
+    sign, x = data[0], int.from_bytes(data[1:33], "big")
+    y_sq = (pow(x, 3, P) + 7) % P
+    y = pow(y_sq, (P + 1) // 4, P)
+    if pow(y, 2, P) != y_sq:
+        raise ValueError("not a curve point")
+    if (y & 1) != (sign - 2):
+        y = P - y
+    return x, y
+
+
+def _challenge(r_point, message: bytes) -> int:
+    return int.from_bytes(
+        hashlib.sha256(_encode_point(r_point) + message).digest(),
+        "big") % N
+
+
+@dataclass
+class BlindSignature:
+    """Final unblinded signature: ``(R', s')`` plus the signer's key."""
+    r_point: tuple
+    s: int
+    pubkey: bytes
+
+    def serialize(self) -> bytes:
+        return _encode_point(self.r_point) + self.s.to_bytes(32, "big") \
+            + self.pubkey
+
+    @classmethod
+    def deserialize(cls, data: bytes) -> "BlindSignature":
+        return cls(_decode_point(data[:33]),
+                   int.from_bytes(data[33:65], "big"), data[65:98])
+
+
+class BlindSigner:
+    """Holds the signing key; never sees the message it signs."""
+
+    def __init__(self, secret: int | None = None):
+        self.secret = secret or (secrets.randbelow(N - 1) + 1)
+        self.pub_point = _mul(self.secret)
+        self._nonces: dict[bytes, int] = {}
+
+    @property
+    def pubkey(self) -> bytes:
+        return _encode_point(self.pub_point)
+
+    def new_request(self) -> bytes:
+        """Step 1: a fresh nonce commitment R for one signature."""
+        r = secrets.randbelow(N - 1) + 1
+        commitment = _encode_point(_mul(r))
+        self._nonces[commitment] = r
+        return commitment
+
+    def sign_blind(self, commitment: bytes, blinded_challenge: int) -> int:
+        """Step 3: s = r + c·x.  The nonce is single-use (a reused
+        Schnorr nonce leaks the key)."""
+        r = self._nonces.pop(commitment)
+        return (r + blinded_challenge * self.secret) % N
+
+
+class BlindRequester:
+    """Blinds a message for signing, unblinds the result."""
+
+    def __init__(self, signer_pubkey: bytes, commitment: bytes,
+                 message: bytes):
+        self.pubkey = signer_pubkey
+        self.message = message
+        x_point = _decode_point(signer_pubkey)
+        r_point = _decode_point(commitment)
+        self.alpha = secrets.randbelow(N - 1) + 1
+        self.beta = secrets.randbelow(N - 1) + 1
+        self.r_blind = _add(_add(r_point, _mul(self.alpha)),
+                            _mul(self.beta, x_point))
+        self.challenge = _challenge(self.r_blind, message)
+
+    @property
+    def blinded_challenge(self) -> int:
+        """What the signer sees: c = c' + β — statistically independent
+        of the message."""
+        return (self.challenge + self.beta) % N
+
+    def unblind(self, blind_s: int) -> BlindSignature:
+        return BlindSignature(self.r_blind, (blind_s + self.alpha) % N,
+                              self.pubkey)
+
+
+def verify(sig: BlindSignature, message: bytes) -> bool:
+    """s'·G == R' + H(R' ‖ m)·X."""
+    try:
+        x_point = _decode_point(sig.pubkey)
+    except ValueError:
+        return False
+    c = _challenge(sig.r_point, message)
+    lhs = _mul(sig.s)
+    rhs = _add(sig.r_point, _mul(c, x_point))
+    return lhs == rhs
+
+
+def blind_sign_roundtrip(signer: BlindSigner,
+                         message: bytes) -> BlindSignature:
+    """The full 3-message protocol in one call (both roles local) —
+    what the voucher chain uses to extend itself."""
+    commitment = signer.new_request()
+    req = BlindRequester(signer.pubkey, commitment, message)
+    return req.unblind(signer.sign_blind(commitment,
+                                         req.blinded_challenge))
+
+
+class SignatureChain:
+    """Vouching chain (reference eccblindchain.py role): link i's key
+    signs link i+1's pubkey; the last key signs the payload.  Valid iff
+    every link verifies and the chain starts at the trusted root."""
+
+    def __init__(self, root_pubkey: bytes):
+        self.root_pubkey = root_pubkey
+        self.links: list[tuple[bytes, BlindSignature]] = []
+
+    def extend(self, signer: BlindSigner, new_pubkey: bytes) -> None:
+        expected = self.links[-1][0] if self.links else self.root_pubkey
+        if signer.pubkey != expected:
+            raise ValueError("chain must be extended by its tip key")
+        self.links.append((new_pubkey,
+                           blind_sign_roundtrip(signer, new_pubkey)))
+
+    def verify_payload(self, payload: bytes,
+                       sig: BlindSignature) -> bool:
+        key = self.root_pubkey
+        for pub, link_sig in self.links:
+            if link_sig.pubkey != key or not verify(link_sig, pub):
+                return False
+            key = pub
+        return sig.pubkey == key and verify(sig, payload)

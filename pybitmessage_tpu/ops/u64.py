@@ -78,6 +78,29 @@ def shr64(a, n: int):
     return hi >> n, (lo >> n) | (hi << (32 - n))
 
 
+def mul_u32_const(x, c: int):
+    """Full 64-bit product of a uint32 array/scalar and a static
+    constant ``c`` < 2^32, as a (hi, lo) pair.
+
+    Built from four 16x16 partial products so no intermediate wraps:
+    ``x*c = xh*a*2^32 + (xh*b + xl*a)*2^16 + xl*b`` with
+    ``x = xh*2^16 + xl`` and ``c = a*2^16 + b``.
+    """
+    assert 0 <= c < (1 << 32)
+    a, b = c >> 16, c & 0xFFFF
+    xh = x >> 16
+    xl = x & jnp.uint32(0xFFFF)
+    zero = jnp.zeros_like(x)
+
+    def shifted16(p):            # p * 2^16 as a u64 pair
+        return p >> 16, p << 16
+
+    acc = (xh * jnp.uint32(a), zero)          # xh*a*2^32
+    acc = add64(acc, shifted16(xh * jnp.uint32(b)))
+    acc = add64(acc, shifted16(xl * jnp.uint32(a)))
+    return add64(acc, (zero, xl * jnp.uint32(b)))
+
+
 def le64(a, b):
     """a <= b, elementwise over pairs."""
     a_hi, a_lo = a

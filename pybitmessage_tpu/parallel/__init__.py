@@ -12,3 +12,7 @@ from .pow_sharded import (  # noqa: F401
     get_sharded_batch_search, get_sharded_search, make_sharded_batch_search,
     make_sharded_search, sharded_solve, sharded_solve_batch,
 )
+from .pow_pallas_sharded import (  # noqa: F401
+    make_pallas_sharded_batch_search, make_pallas_sharded_search,
+    pallas_sharded_solve, pallas_sharded_solve_batch,
+)

@@ -1,0 +1,367 @@
+"""Pod-sharded PoW built on the production Pallas kernel.
+
+The per-chip slab is the SAME Mosaic kernel the single-chip tier runs
+(``ops/sha512_pallas.py``, 84.6 MH/s/chip on a v5e vs 25.8 for the XLA
+windowed fallback): a ``pl.pallas_call`` per device under ``shard_map``,
+device *d* searching the contiguous slab
+``[base + d*slab, base + (d+1)*slab)`` — the multi-chip generalization
+of the reference's per-thread nonce striding
+(src/bitmsghash/bitmsghash.cpp:76-125), with the OpenCL host-loop slab
+granularity (src/openclpow.py:96-107) scaled to the whole pod.
+
+Early exit happens at two granularities:
+- WITHIN a device, the kernel's SMEM found-flag skips remaining grid
+  steps after a hit (per-object in the batch kernel);
+- ACROSS the pod, each jitted call ends with a tiny ``all_gather`` of
+  per-device (hit, nonce) over the mesh axis (rides ICI), and the host
+  loop stops dispatching slabs once any device reports a hit.
+
+There is deliberately no per-chunk cross-chip collective here: Mosaic
+kernels cannot issue ICI collectives mid-grid, and a slab is ~200 ms of
+work, so the worst-case overshoot (one slab's tail on the other chips)
+matches the reference OpenCL driver's batch-granular exit.
+
+On hosts without a TPU (the virtual CPU meshes the test suite and the
+driver's multi-chip dryrun use), ``impl="xla"`` swaps the per-device
+slab for an equivalent ``lax.scan`` over the XLA windowed kernel —
+identical partitioning, winner resolution and host loop, so the
+sharding logic is fully exercised without Mosaic.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..ops.sha512_jax import DEFAULT_VARIANT, trial_values
+from ..ops.sha512_pallas import (LANE_COLS, DEFAULT_CHUNKS, DEFAULT_ROWS,
+                                 pallas_batch_search, pallas_search)
+from ..ops.u64 import U32, add64, le64, mul_u32_const
+from ..ops.pow_search import PowInterrupted
+
+_MASK64 = (1 << 64) - 1
+
+
+def default_impl() -> str:
+    """"pallas" on an accelerator backend, "xla" on host CPU."""
+    try:
+        return "pallas" if jax.default_backend() != "cpu" else "xla"
+    except Exception:  # pragma: no cover - backend probe failure
+        return "xla"
+
+
+def _xla_slab(ih_words, base, target, *, rows: int, chunks: int,
+              variant: str = DEFAULT_VARIANT):
+    """XLA stand-in for one device's Pallas slab (same output contract:
+    found (chunks,) int32, nonce (chunks, 2) uint32)."""
+    lanes = rows * LANE_COLS
+    ih_hi, ih_lo = ih_words[:, 0], ih_words[:, 1]
+    t = (target[0], target[1])
+
+    def step(carry, _):
+        b_hi, b_lo = carry
+        (v_hi, v_lo), (c_hi, c_lo) = trial_values(
+            b_hi, b_lo, ih_hi, ih_lo, lanes, variant)
+        ok = le64((v_hi, v_lo), t)
+        idx = jnp.argmax(ok)
+        out = (jnp.any(ok).astype(jnp.int32),
+               jnp.stack([c_hi[idx], c_lo[idx]]))
+        nxt = add64((b_hi, b_lo), (jnp.uint32(0), jnp.uint32(lanes)))
+        return nxt, out
+
+    _, (found, nonce) = jax.lax.scan(
+        step, (base[0], base[1]), None, length=chunks)
+    return found, nonce
+
+
+def _first_hit(found, nonce):
+    """First hit in one device's slab -> (hit, nonce_hi, nonce_lo)."""
+    idx = jnp.argmax(found > 0)
+    return found[idx] > 0, nonce[idx, 0], nonce[idx, 1]
+
+
+def _resolve_winner(hit, n_hi, n_lo, axis: str):
+    """all_gather per-device results and replicate the first winner.
+
+    Returned PACKED as one (3,) uint32 array [found, nonce_hi,
+    nonce_lo]: through the remote-execution relay every separate
+    output array costs a device->host fetch per harvest, and three
+    scalar fetches per slab measurably drag the host loop (2.6x on the
+    r3 first cut)."""
+    hits = jax.lax.all_gather(hit, axis)
+    nhs = jax.lax.all_gather(n_hi, axis)
+    nls = jax.lax.all_gather(n_lo, axis)
+    win = jnp.argmax(hits)
+    return jnp.stack([jnp.any(hits).astype(U32), nhs[win], nls[win]])
+
+
+def make_pallas_sharded_search(mesh: Mesh, *, rows: int = DEFAULT_ROWS,
+                               chunks: int = DEFAULT_CHUNKS,
+                               axis: str | None = None,
+                               impl: str = "pallas",
+                               interpret: bool = False,
+                               variant: str = DEFAULT_VARIANT):
+    """Jitted pod-wide single-object search over ``mesh``.
+
+    ``fn(ih_words (8,2), base (2,), target (2,)) -> (3,) uint32
+    [found, nonce_hi, nonce_lo]``, everything replicated; each device
+    runs one Pallas slab on its share of the nonce range.
+    """
+    if axis is None:
+        axis = mesh.axis_names[-1]
+    slab = rows * LANE_COLS * chunks
+
+    def body(ih_words, base, target):
+        dev = jax.lax.axis_index(axis).astype(U32)
+        b_hi, b_lo = add64((base[0], base[1]), mul_u32_const(dev, slab))
+        local_base = jnp.stack([b_hi, b_lo])
+        if impl == "pallas":
+            found, nonce = pallas_search(ih_words, local_base, target,
+                                         rows=rows, chunks=chunks,
+                                         interpret=interpret)
+        else:
+            found, nonce = _xla_slab(ih_words, local_base, target,
+                                     rows=rows, chunks=chunks,
+                                     variant=variant)
+        return _resolve_winner(*_first_hit(found, nonce), axis)
+
+    reps = P()
+    fn = shard_map(body, mesh=mesh, in_specs=(reps,) * 3,
+                   out_specs=reps, check_vma=False)
+    return jax.jit(fn)
+
+
+def make_pallas_sharded_batch_search(mesh: Mesh, *,
+                                     rows: int = DEFAULT_ROWS,
+                                     chunks: int = DEFAULT_CHUNKS,
+                                     obj_axis: str | None = None,
+                                     nonce_axis: str | None = None,
+                                     impl: str = "pallas",
+                                     interpret: bool = False,
+                                     variant: str = DEFAULT_VARIANT):
+    """Jitted pod-wide BATCH search over a 2D (obj x nonce) mesh.
+
+    Objects are data-parallel over ``obj_axis`` (each device holds
+    B/obj_size of them); each object's nonce range is partitioned over
+    ``nonce_axis``.  One Pallas batch-kernel launch per device covers
+    its local (objects x chunks) grid with per-object early exit.
+    ``fn(ih_words (B,8,2), bases (B,2), targets (B,2)) -> (B, 3)
+    uint32 rows of [found, nonce_hi, nonce_lo]``.
+    """
+    if obj_axis is None:
+        obj_axis = mesh.axis_names[0]
+    if nonce_axis is None:
+        nonce_axis = mesh.axis_names[-1]
+    slab = rows * LANE_COLS * chunks
+
+    def body(ih_words, bases, targets):
+        dev = jax.lax.axis_index(nonce_axis).astype(U32)
+        off = mul_u32_const(dev, slab)
+
+        def offset(b):
+            h, lo = add64((b[0], b[1]), off)
+            return jnp.stack([h, lo])
+
+        local_bases = jax.vmap(offset)(bases)
+        if impl == "pallas":
+            found, nonce = pallas_batch_search(
+                ih_words, local_bases, targets, rows=rows, chunks=chunks,
+                interpret=interpret)
+        else:
+            found, nonce = jax.vmap(
+                lambda iw, b, t: _xla_slab(iw, b, t, rows=rows,
+                                           chunks=chunks, variant=variant)
+            )(ih_words, local_bases, targets)
+        hit, n_hi, n_lo = jax.vmap(_first_hit)(found, nonce)
+        hits = jax.lax.all_gather(hit, nonce_axis)        # (D, B_local)
+        nhs = jax.lax.all_gather(n_hi, nonce_axis)
+        nls = jax.lax.all_gather(n_lo, nonce_axis)
+        win = jnp.argmax(hits, axis=0)
+        lane = jnp.arange(hits.shape[1])
+        # packed (B_local, 3): one device->host fetch per harvest
+        return jnp.stack([jnp.any(hits, axis=0).astype(U32),
+                          nhs[win, lane], nls[win, lane]], axis=-1)
+
+    fn = shard_map(
+        body, mesh=mesh,
+        in_specs=(P(obj_axis, None, None), P(obj_axis, None),
+                  P(obj_axis, None)),
+        out_specs=P(obj_axis, None), check_vma=False)
+    return jax.jit(fn)
+
+
+#: jitted-fn cache — re-wrapping shard_map would defeat jit's compile
+#: cache and recompile on every solve
+_FN_CACHE: dict = {}
+
+
+def _get_fn(mesh: Mesh, kind: str, rows: int, chunks: int, impl: str,
+            interpret: bool, variant: str):
+    key = (mesh, kind, rows, chunks, impl, interpret, variant)
+    if key not in _FN_CACHE:
+        make = (make_pallas_sharded_search if kind == "single"
+                else make_pallas_sharded_batch_search)
+        _FN_CACHE[key] = make(mesh, rows=rows, chunks=chunks, impl=impl,
+                              interpret=interpret, variant=variant)
+    return _FN_CACHE[key]
+
+
+def _ih_words_arr(initial_hash: bytes):
+    words = [int.from_bytes(initial_hash[i:i + 8], "big")
+             for i in range(0, 64, 8)]
+    return jnp.array([[w >> 32, w & 0xFFFFFFFF] for w in words], dtype=U32)
+
+
+def _pair_arr(value: int):
+    value &= _MASK64
+    return jnp.array([value >> 32, value & 0xFFFFFFFF], dtype=U32)
+
+
+def pallas_sharded_solve(initial_hash: bytes, target: int, mesh: Mesh, *,
+                         start_nonce: int = 0, rows: int = DEFAULT_ROWS,
+                         chunks_per_call: int = DEFAULT_CHUNKS,
+                         impl: str | None = None, interpret: bool = False,
+                         variant: str = DEFAULT_VARIANT,
+                         should_stop: Callable[[], bool] | None = None):
+    """Pod-wide solve running the production Pallas kernel per chip.
+
+    Same contract as ``ops.solve`` / ``sha512_pallas.solve``: returns
+    ``(nonce, trials)`` or raises ``PowInterrupted``.  Double-buffered
+    host loop (one pod slab in flight ahead of the harvest) with
+    stride ``ndev * rows*128*chunks`` per call.
+    """
+    import numpy as np
+
+    from ..utils.hashes import double_sha512
+
+    if impl is None:
+        impl = default_impl()
+    ndev = mesh.devices.size
+    nonce_devs = mesh.shape[mesh.axis_names[-1]] if len(mesh.axis_names) > 1 \
+        else ndev
+    fn = _get_fn(mesh, "single", rows, chunks_per_call, impl, interpret,
+                 variant)
+    ih_words = _ih_words_arr(initial_hash)
+    target &= _MASK64
+    target_arr = _pair_arr(target)
+    slab = rows * LANE_COLS * chunks_per_call
+    stride = nonce_devs * slab
+
+    def harvest(out):
+        found, n_hi, n_lo = np.asarray(out)     # one packed fetch
+        if not found:
+            return None
+        nonce = (int(n_hi) << 32) | int(n_lo)
+        check = double_sha512(nonce.to_bytes(8, "big") + initial_hash)
+        if int.from_bytes(check[:8], "big") > target:  # pragma: no cover
+            raise ArithmeticError("accelerator returned an invalid nonce")
+        return nonce
+
+    base = start_nonce & _MASK64
+    trials = 0
+    pending = None
+    while True:
+        if should_stop is not None and should_stop():
+            if pending is not None:
+                trials += stride
+                nonce = harvest(pending)
+                if nonce is not None:
+                    return nonce, trials
+            raise PowInterrupted("sharded Pallas PoW interrupted")
+        current = fn(ih_words, _pair_arr(base), target_arr)
+        base = (base + stride) & _MASK64
+        if pending is not None:
+            trials += stride
+            nonce = harvest(pending)
+            if nonce is not None:
+                return nonce, trials
+        pending = current
+
+
+#: always-hit target: every trial value is <= 2^64-1, so pad/done slots
+#: hit on their first chunk and the per-object kernel flag then skips
+#: the rest of their grid (contrast reference openclpow which has no
+#: batch concept at all)
+_ALWAYS_HIT = _MASK64
+
+
+def pallas_sharded_solve_batch(items, mesh: Mesh, *,
+                               rows: int = DEFAULT_ROWS,
+                               chunks_per_call: int = DEFAULT_CHUNKS,
+                               impl: str | None = None,
+                               interpret: bool = False,
+                               variant: str = DEFAULT_VARIANT,
+                               should_stop: Callable[[], bool] | None = None):
+    """Solve ``[(initial_hash, target), ...]`` pod-wide, Pallas per chip.
+
+    2D (obj x nonce) mesh: objects data-parallel, nonce ranges
+    partitioned.  Per-object early exit across slabs: once an object
+    solves, its target flips to always-hit so its lanes stop after one
+    chunk of the next launch, and its trials stop accruing; the batch
+    is padded with always-hit dummies (never duplicated real work).
+    Returns ``[(nonce, trials), ...]`` aligned with ``items``.
+    """
+    import numpy as np
+
+    from ..utils.hashes import double_sha512
+
+    n = len(items)
+    if n == 0:
+        return []
+    if impl is None:
+        impl = default_impl()
+    if len(mesh.axis_names) < 2:
+        return [pallas_sharded_solve(ih, t, mesh, rows=rows,
+                                     chunks_per_call=chunks_per_call,
+                                     impl=impl, interpret=interpret,
+                                     variant=variant,
+                                     should_stop=should_stop)
+                for ih, t in items]
+
+    obj_size = mesh.shape[mesh.axis_names[0]]
+    nonce_devs = mesh.shape[mesh.axis_names[-1]]
+    pad = -n % obj_size
+    total = n + pad
+    ihs = [ih for ih, _ in items] + [b"\x00" * 64] * pad
+    targets = [t & _MASK64 for _, t in items] + [_ALWAYS_HIT] * pad
+
+    fn = _get_fn(mesh, "batch", rows, chunks_per_call, impl, interpret,
+                 variant)
+    ih_words = jnp.stack([_ih_words_arr(ih) for ih in ihs])
+    t_arr = jnp.stack([_pair_arr(t) for t in targets])
+    slab = rows * LANE_COLS * chunks_per_call
+    stride = nonce_devs * slab
+
+    bases = [0] * total
+    trials = [0] * total
+    nonces: list[int | None] = [None] * total
+    done = [i >= n for i in range(total)]      # pad slots start done
+    while not all(done):
+        if should_stop is not None and should_stop():
+            raise PowInterrupted("sharded batched Pallas PoW interrupted")
+        b_arr = jnp.stack([_pair_arr(b) for b in bases])
+        packed = np.asarray(fn(ih_words, b_arr, t_arr))
+        found, n_hi, n_lo = packed[:, 0], packed[:, 1], packed[:, 2]
+        for i in range(total):
+            if done[i]:
+                continue
+            trials[i] += stride
+            if found[i]:
+                nonce = (int(n_hi[i]) << 32) | int(n_lo[i])
+                check = double_sha512(nonce.to_bytes(8, "big") + ihs[i])
+                if int.from_bytes(check[:8], "big") > targets[i]:
+                    raise ArithmeticError(
+                        "accelerator returned an invalid nonce")
+                nonces[i] = nonce
+                done[i] = True
+                # flip to always-hit: from the next launch this object's
+                # lanes set their per-object flag at chunk 0 and skip out
+                t_arr = t_arr.at[i].set(
+                    jnp.array([0xFFFFFFFF, 0xFFFFFFFF], dtype=U32))
+            else:
+                bases[i] = (bases[i] + stride) & _MASK64
+    return [(nonces[i], trials[i]) for i in range(n)]

@@ -180,7 +180,9 @@ def sharded_solve_batch(items, mesh: Mesh, *, lanes: int = 1 << 13,
     obj_size = mesh.shape[mesh.axis_names[0]] if len(mesh.axis_names) > 1 \
         else 1
     nonce_size = mesh.shape[mesh.axis_names[-1]]
-    padded = list(items) + [items[-1]] * (-n % obj_size)
+    # pad with always-hit dummies: a duplicated real item would re-solve
+    # its full difficulty and hold the vmapped while_loop open for it
+    padded = list(items) + [(b"\x00" * 64, _MASK64)] * (-n % obj_size)
     total = len(padded)
     fn = get_sharded_batch_search(mesh, lanes=lanes,
                                   max_chunks=chunks_per_call,
@@ -224,6 +226,11 @@ def sharded_solve_batch(items, mesh: Mesh, *, lanes: int = 1 << 13,
                     raise ArithmeticError(
                         "accelerator returned an invalid PoW nonce")
                 nonces[i] = nonce
+                # mask the solved object: with an always-hit target its
+                # vmapped while_loop lane exits on the first chunk of
+                # any subsequent launch instead of re-solving
+                t_hi = t_hi.at[i].set(jnp.uint32(0xFFFFFFFF))
+                t_lo = t_lo.at[i].set(jnp.uint32(0xFFFFFFFF))
             else:
                 bases[i] = (bases[i] + c * step) & _MASK64
     return [(nonces[i], trials[i]) for i in range(n)]

@@ -236,6 +236,53 @@ class MessageStore:
         self._db.execute("DELETE FROM addressbook WHERE address=?",
                          (address,))
 
+    # -- black/whitelist -----------------------------------------------------
+    # Reference: the Qt frontend maintains ``blacklist``/``whitelist``
+    # tables and a ``blackwhitelist`` mode setting; objectProcessor
+    # drops inbound messages from blacklisted senders (or, in whitelist
+    # mode, from anyone NOT whitelisted) before inbox insertion
+    # (src/class_objectProcessor.py processmsg, bitmessageqt/blacklist.py).
+
+    def listing(self, which: str) -> list[tuple[str, str, bool]]:
+        """(label, address, enabled) rows of 'blacklist' or 'whitelist'."""
+        assert which in ("blacklist", "whitelist")
+        return [(r[0], r[1], bool(r[2])) for r in self._db.query(
+            "SELECT label, address, enabled FROM %s" % which)]
+
+    def listing_add(self, which: str, address: str, label: str) -> bool:
+        assert which in ("blacklist", "whitelist")
+        if self._db.query("SELECT COUNT(*) FROM %s WHERE address=?" % which,
+                          (address,))[0][0]:
+            return False
+        self._db.execute("INSERT INTO %s VALUES (?,?,1)" % which,
+                         (label, address))
+        return True
+
+    def listing_delete(self, which: str, address: str) -> None:
+        assert which in ("blacklist", "whitelist")
+        self._db.execute("DELETE FROM %s WHERE address=?" % which,
+                         (address,))
+
+    def listing_set_enabled(self, which: str, address: str,
+                            enabled: bool) -> None:
+        assert which in ("blacklist", "whitelist")
+        self._db.execute("UPDATE %s SET enabled=? WHERE address=?" % which,
+                         (int(enabled), address))
+
+    def sender_allowed(self, from_address: str, mode: str) -> bool:
+        """Apply the black/whitelist policy to an inbound sender.
+
+        ``mode``: 'black' — allow unless on an enabled blacklist row;
+        'white' — allow only when on an enabled whitelist row.
+        """
+        if mode == "white":
+            return bool(self._db.query(
+                "SELECT COUNT(*) FROM whitelist WHERE address=? AND enabled=1",
+                (from_address,))[0][0])
+        return not self._db.query(
+            "SELECT COUNT(*) FROM blacklist WHERE address=? AND enabled=1",
+            (from_address,))[0][0]
+
     # -- pubkeys -------------------------------------------------------------
 
     def store_pubkey(self, address: str, version: int, payload: bytes,

@@ -60,6 +60,11 @@ class ObjectProcessor:
         self.shutdown = shutdown or asyncio.Event()
         self.min_ntpb = min_ntpb
         self.min_extra = min_extra
+        #: black/whitelist policy: 'black' (default) drops enabled
+        #: blacklist rows, 'white' accepts only enabled whitelist rows
+        #: (reference objectProcessor processmsg + bmconfigparser
+        #: 'blackwhitelist' setting)
+        self.list_mode = "black"
         # 32 MB backpressure on unprocessed payload bytes (reference
         # queues.py:14-38) — floods stall readers, not memory
         from ..utils.queues import ByteBoundedQueue
@@ -276,6 +281,13 @@ class ObjectProcessor:
         from_address = encode_address(plain.sender_version,
                                       plain.sender_stream, sender_ripe)
         sighash = sha512(plain.signature)
+        # black/whitelist policy, before any inbox insert
+        # (objectProcessor processmsg; chans bypass the lists there too)
+        if not match.chan and not self.store.sender_allowed(
+                from_address, self.list_mode):
+            logger.info("message from %s dropped by %slist policy",
+                        from_address, self.list_mode)
+            return
         body = msgcoding.decode_message(plain.message, plain.encoding)
         if not self.store.deliver_inbox(
                 msgid=inventory_hash(payload), toaddress=match.address,

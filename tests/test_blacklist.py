@@ -1,0 +1,180 @@
+"""Black/whitelist subsystem: store policy, processor enforcement,
+API extension commands (reference: blacklist/whitelist SQL tables +
+bitmessageqt/blacklist.py + objectProcessor's processmsg check)."""
+
+import asyncio
+import base64
+import json
+import time
+
+import pytest
+
+from pybitmessage_tpu.core import Node
+from pybitmessage_tpu.ops import solve
+from pybitmessage_tpu.storage.db import Database
+from pybitmessage_tpu.storage.messages import MessageStore
+
+
+def _b64(s: str) -> str:
+    return base64.b64encode(s.encode()).decode()
+
+
+# -- store policy ------------------------------------------------------------
+
+def test_sender_allowed_black_mode():
+    store = MessageStore(Database())
+    assert store.sender_allowed("BM-alice", "black")
+    store.listing_add("blacklist", "BM-alice", "spammer")
+    assert not store.sender_allowed("BM-alice", "black")
+    # disabled rows don't drop
+    store.listing_set_enabled("blacklist", "BM-alice", False)
+    assert store.sender_allowed("BM-alice", "black")
+    store.listing_delete("blacklist", "BM-alice")
+    assert store.sender_allowed("BM-alice", "black")
+
+
+def test_sender_allowed_white_mode():
+    store = MessageStore(Database())
+    assert not store.sender_allowed("BM-bob", "white")
+    store.listing_add("whitelist", "BM-bob", "friend")
+    assert store.sender_allowed("BM-bob", "white")
+    store.listing_set_enabled("whitelist", "BM-bob", False)
+    assert not store.sender_allowed("BM-bob", "white")
+
+
+def test_listing_duplicates_rejected():
+    store = MessageStore(Database())
+    assert store.listing_add("blacklist", "BM-x", "one")
+    assert not store.listing_add("blacklist", "BM-x", "again")
+    assert store.listing("blacklist") == [("one", "BM-x", True)]
+
+
+# -- processor enforcement ---------------------------------------------------
+
+def _test_solver(initial_hash, target, should_stop=None):
+    return solve(initial_hash, target, lanes=4096, chunks_per_call=16,
+                 should_stop=should_stop)
+
+
+async def _wait_for(predicate, timeout=60.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if predicate():
+            return True
+        await asyncio.sleep(0.1)
+    return False
+
+
+@pytest.mark.asyncio
+async def test_blacklisted_sender_dropped_before_inbox():
+    """An inbound msg OBJECT from a blacklisted sender passes
+    PoW/decrypt/signature but must never reach the inbox.  (Loopback
+    self-sends bypass the processor by design, matching the reference's
+    singleWorker direct delivery — so this feeds the encrypted object
+    through the processor queue the way a network arrival would.)"""
+    node = Node(listen=False, solver=_test_solver, test_mode=True)
+    await node.start()
+    try:
+        me = node.create_identity("me")
+        # loopback PoW is solved at the test-mode network minimum; align
+        # the identity's demanded difficulty so the re-injected object
+        # passes the processor's recheck and reaches the list policy
+        me.nonce_trials_per_byte = node.processor.min_ntpb
+        me.extra_bytes = node.processor.min_extra
+        await node.send_message(me.address, me.address, "subj", "body",
+                                ttl=300)
+        assert await _wait_for(
+            lambda: len(node.inventory.unexpired_hashes_by_stream(1)) >= 1
+            and len(node.store.inbox()) == 1)   # loopback copy landed
+        [obj_hash] = node.inventory.unexpired_hashes_by_stream(1)
+        payload = node.inventory[obj_hash].payload
+        # wipe the loopback row entirely (trash would leave the sighash
+        # for dedup) and re-inject the wire object, now blacklisted
+        node.db.execute("DELETE FROM inbox")
+        node.store.listing_add("blacklist", me.address, "self-block")
+        node.processor.queue.put_nowait(payload)
+        await asyncio.sleep(1.5)
+        assert node.store.inbox() == []
+        # control: without the blacklist row the same object delivers
+        node.store.listing_delete("blacklist", me.address)
+        node.processor.queue.put_nowait(payload)
+        assert await _wait_for(lambda: len(node.store.inbox()) == 1)
+    finally:
+        await node.stop()
+
+
+@pytest.mark.asyncio
+async def test_whitelist_mode_allows_listed_sender():
+    node = Node(listen=False, solver=_test_solver, test_mode=True)
+    node.processor.list_mode = "white"
+    await node.start()
+    try:
+        me = node.create_identity("me")
+        node.store.listing_add("whitelist", me.address, "me")
+        await node.send_message(me.address, me.address, "ok", "body",
+                                ttl=300)
+        assert await _wait_for(lambda: len(node.store.inbox()) == 1)
+    finally:
+        await node.stop()
+
+
+# -- API extension commands --------------------------------------------------
+
+@pytest.mark.asyncio
+async def test_blacklist_api_roundtrip():
+    from pybitmessage_tpu.api import APIServer
+
+    node = Node(listen=False, solver=_test_solver, test_mode=True)
+    await node.start()
+    api = APIServer(node, port=0, username="u", password="p")
+    await api.start()
+    try:
+        me = node.create_identity("listed")
+        out = await api.handler.dispatch(
+            "addBlacklistEntry", [me.address, _b64("spammer")])
+        assert "Added" in out
+        rows = json.loads(await api.handler.dispatch(
+            "listBlacklistEntries", []))["blacklist"]
+        assert rows == [{"label": _b64("spammer"), "address": me.address,
+                         "enabled": True}]
+        assert await api.handler.dispatch("getBlackWhitelistMode", []) \
+            == "black"
+        assert await api.handler.dispatch(
+            "setBlackWhitelistMode", ["white"]) == "success"
+        assert node.processor.list_mode == "white"
+        await api.handler.dispatch("deleteBlacklistEntry", [me.address])
+        rows = json.loads(await api.handler.dispatch(
+            "listBlacklistEntries", []))["blacklist"]
+        assert rows == []
+    finally:
+        await api.stop()
+        await node.stop()
+
+
+@pytest.mark.asyncio
+async def test_settings_api_roundtrip():
+    from pybitmessage_tpu.api import APIServer
+
+    node = Node(listen=False, solver=_test_solver, test_mode=True)
+    await node.start()
+    api = APIServer(node, port=0, username="u", password="p")
+    await api.start()
+    try:
+        settings = json.loads(await api.handler.dispatch("getSettings", []))
+        assert settings["port"] == "8444"
+        assert "apipassword" not in settings
+        assert await api.handler.dispatch(
+            "updateSetting", ["maxdownloadrate", "250"]) == "success"
+        assert node.ctx.download_bucket.rate == 250 * 1024
+        # validator rejections surface as API errors
+        from pybitmessage_tpu.api.commands import APIError
+        with pytest.raises(APIError):
+            await api.handler.dispatch(
+                "updateSetting", ["dandelion", "101"])
+        # typo'd option names must error, not silently persist
+        with pytest.raises(APIError):
+            await api.handler.dispatch(
+                "updateSetting", ["maxuploadrte", "100"])
+    finally:
+        await api.stop()
+        await node.stop()

@@ -1,0 +1,80 @@
+"""ECC blind signatures (crypto/blindsig.py — the pyelliptic
+eccblind.py / eccblindchain.py capability; reference tests
+src/pyelliptic/tests/test_blindsig.py)."""
+
+import pytest
+
+from pybitmessage_tpu.crypto import blindsig
+from pybitmessage_tpu.crypto.blindsig import (
+    BlindRequester, BlindSignature, BlindSigner, SignatureChain,
+    blind_sign_roundtrip, verify,
+)
+
+
+def test_blind_sign_roundtrip_verifies():
+    signer = BlindSigner()
+    sig = blind_sign_roundtrip(signer, b"voucher payload")
+    assert verify(sig, b"voucher payload")
+
+
+def test_signature_bound_to_message():
+    signer = BlindSigner()
+    sig = blind_sign_roundtrip(signer, b"original")
+    assert not verify(sig, b"tampered")
+
+
+def test_signature_bound_to_key():
+    sig = blind_sign_roundtrip(BlindSigner(), b"msg")
+    other = BlindSigner()
+    forged = BlindSignature(sig.r_point, sig.s, other.pubkey)
+    assert not verify(forged, b"msg")
+
+
+def test_signer_never_sees_message_or_challenge():
+    """The challenge the signer receives is blinded: two requesters of
+    the SAME message produce different blinded challenges."""
+    signer = BlindSigner()
+    c1 = BlindRequester(signer.pubkey, signer.new_request(), b"m")
+    c2 = BlindRequester(signer.pubkey, signer.new_request(), b"m")
+    assert c1.blinded_challenge != c2.blinded_challenge
+
+
+def test_nonce_single_use():
+    signer = BlindSigner()
+    commitment = signer.new_request()
+    req = BlindRequester(signer.pubkey, commitment, b"m")
+    signer.sign_blind(commitment, req.blinded_challenge)
+    with pytest.raises(KeyError):
+        signer.sign_blind(commitment, req.blinded_challenge)
+
+
+def test_serialize_roundtrip():
+    sig = blind_sign_roundtrip(BlindSigner(), b"wire")
+    data = sig.serialize()
+    back = BlindSignature.deserialize(data)
+    assert back == sig
+    assert verify(back, b"wire")
+
+
+def test_point_codec_roundtrip():
+    point = blindsig._mul(123456789)
+    assert blindsig._decode_point(blindsig._encode_point(point)) == point
+
+
+def test_chain_two_levels():
+    root = BlindSigner()
+    mid = BlindSigner()
+    chain = SignatureChain(root.pubkey)
+    chain.extend(root, mid.pubkey)
+    payload_sig = blind_sign_roundtrip(mid, b"leaf payload")
+    assert chain.verify_payload(b"leaf payload", payload_sig)
+    # a signature by a key outside the chain fails
+    rogue_sig = blind_sign_roundtrip(BlindSigner(), b"leaf payload")
+    assert not chain.verify_payload(b"leaf payload", rogue_sig)
+
+
+def test_chain_rejects_wrong_extender():
+    root, mid = BlindSigner(), BlindSigner()
+    chain = SignatureChain(root.pubkey)
+    with pytest.raises(ValueError):
+        chain.extend(mid, BlindSigner().pubkey)   # mid isn't the tip

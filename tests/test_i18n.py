@@ -1,0 +1,68 @@
+"""Translation shim (core/i18n.py — the reference tr.py/l10n.py role)."""
+
+import time
+
+from pybitmessage_tpu.core import i18n
+
+
+def teardown_function(_fn):
+    i18n.install("en")      # leave the process untranslated
+
+
+def test_default_is_identity():
+    i18n.install("en")
+    assert i18n.tr("Inbox") == "Inbox"
+    assert i18n.language() == "en"
+
+
+def test_german_catalog_roundtrip():
+    assert "de" in i18n.available_languages()
+    assert i18n.install("de") == "de"
+    assert i18n.tr("Inbox") == "Posteingang"
+    assert i18n.tr("Subscriptions") == "Abonnements"
+    # unknown keys fall back to the source string
+    assert i18n.tr("No such key 123") == "No such key 123"
+
+
+def test_placeholder_interpolation():
+    i18n.install("de")
+    assert i18n.tr("Connections: {count}", count=7) == "Verbindungen: 7"
+    # untranslated strings still interpolate
+    assert i18n.tr("Up {n}%", n=3) == "Up 3%"
+
+
+def test_unknown_language_falls_back():
+    assert i18n.install("xx") == "en"
+    assert i18n.tr("Inbox") == "Inbox"
+
+
+def test_env_language_detection(monkeypatch):
+    monkeypatch.setenv("LANGUAGE", "de_DE.UTF-8")
+    assert i18n.install() == "de"
+    monkeypatch.setenv("LANGUAGE", "fr")
+    assert i18n.install() == "en"      # no French catalog shipped
+
+
+def test_po_parser_multiline_and_escapes():
+    po = '''
+msgid ""
+msgstr "header ignored"
+
+msgid "multi "
+"line key"
+msgstr "multi "
+"line value"
+
+msgid "quote \\" and newline\\n"
+msgstr "ok"
+'''
+    cat = i18n.parse_po(po)
+    assert cat == {"multi line key": "multi line value",
+                   'quote " and newline\n': "ok"}
+
+
+def test_format_timestamp_safe():
+    out = i18n.format_timestamp(time.time(), "%Y")
+    assert out == time.strftime("%Y")
+    # invalid format never raises
+    assert i18n.format_timestamp(time.time(), "%") != ""

@@ -1,0 +1,49 @@
+"""Identicon derivation must be deterministic and stable forever
+(utils/identicon.py — the qidenticon.py role; reference test analog
+src/tests/test_identicon.py)."""
+
+from pybitmessage_tpu.utils.identicon import (
+    SIZE, derive, fingerprint, render_compact, render_svg, render_text,
+)
+
+ADDR = "BM-2cUbueSBdACs3ERrRXUgznTASUnfR4Y5GD"
+
+#: golden: pin the v1 derivation — a change here silently re-faces
+#: every address in every frontend
+GOLDEN_FINGERPRINT = "2e6c301dff8d017d"
+GOLDEN_COLOR = (71, 87, 202)
+
+
+def test_golden_fingerprint_stable():
+    assert fingerprint(ADDR) == GOLDEN_FINGERPRINT
+    assert derive(ADDR).color == GOLDEN_COLOR
+
+
+def test_distinct_addresses_distinct_icons():
+    seen = {fingerprint("BM-addr%d" % i) for i in range(50)}
+    assert len(seen) == 50
+
+
+def test_grid_shape_and_symmetry():
+    icon = derive(ADDR)
+    assert len(icon.grid) == SIZE
+    for row in icon.grid:
+        assert len(row) == SIZE
+        assert list(row) == list(row)[::-1], "identicons mirror L-R"
+
+
+def test_renderers_agree_on_cells():
+    icon = derive(ADDR)
+    filled = len(icon.cells())
+    assert render_text(icon).count("█") == filled
+    assert render_svg(icon).count("<rect") == filled + 1  # + background
+    # compact packs two rows per line into half-blocks
+    compact = render_compact(icon)
+    halves = (compact.count("▀") + compact.count("▄")
+              + 2 * compact.count("█"))
+    assert halves == filled
+
+
+def test_deterministic_across_calls():
+    a, b = derive(ADDR), derive(ADDR)
+    assert a == b

@@ -58,6 +58,95 @@ def test_pallas_batch_solve():
 
 
 @requires_accelerator
+def test_pallas_sharded_1dev_mesh_matches_direct():
+    """The sharded tier must run the production Mosaic kernel per chip:
+    on a 1-device mesh its rate must be within ~2x of the direct
+    Pallas solve at the same slab (it IS the same kernel; the margin
+    absorbs shard_map dispatch overhead and rate noise through the
+    relay).  VERDICT r2 #1's real-chip check."""
+    import time
+
+    from pybitmessage_tpu.ops.sha512_pallas import solve
+    from pybitmessage_tpu.parallel import make_mesh, pallas_sharded_solve
+
+    ih = hashlib.sha512(b"sharded == direct").digest()
+    target = 2 ** 40          # unreachable-ish: forces multiple slabs
+    rows, chunks = 256, 128
+
+    def timed(fn):
+        t0 = time.monotonic()
+        try:
+            fn()
+        except Exception:
+            raise
+        return time.monotonic() - t0
+
+    # warm both compiled paths, then time a fixed trial budget via
+    # should_stop after N calls
+    calls = {"n": 0}
+
+    def stop_after(n):
+        def cb():
+            calls["n"] += 1
+            return calls["n"] > n
+        return cb
+
+    from pybitmessage_tpu.ops.pow_search import PowInterrupted
+
+    mesh = make_mesh(1)
+    for warm in range(1):
+        calls["n"] = 0
+        try:
+            solve(ih, target, rows=rows, chunks_per_call=chunks,
+                  should_stop=stop_after(2))
+        except PowInterrupted:
+            pass
+        calls["n"] = 0
+        try:
+            pallas_sharded_solve(ih, target, mesh, rows=rows,
+                                 chunks_per_call=chunks,
+                                 should_stop=stop_after(2))
+        except PowInterrupted:
+            pass
+
+    def run_direct():
+        calls["n"] = 0
+        try:
+            solve(ih, target, rows=rows, chunks_per_call=chunks,
+                  should_stop=stop_after(8))
+        except PowInterrupted:
+            pass
+
+    def run_sharded():
+        calls["n"] = 0
+        try:
+            pallas_sharded_solve(ih, target, mesh, rows=rows,
+                                 chunks_per_call=chunks,
+                                 should_stop=stop_after(8))
+        except PowInterrupted:
+            pass
+
+    t_direct = timed(run_direct)
+    t_sharded = timed(run_sharded)
+    assert t_sharded < 2.0 * t_direct, (
+        "sharded path %.2fs vs direct %.2fs" % (t_sharded, t_direct))
+
+
+@requires_accelerator
+def test_pallas_sharded_solve_on_chip_finds_nonce():
+    from pybitmessage_tpu.parallel import make_mesh, pallas_sharded_solve
+
+    ih = hashlib.sha512(b"sharded pallas on chip").digest()
+    target = 2 ** 55
+    mesh = make_mesh(1)
+    nonce, trials = pallas_sharded_solve(ih, target, mesh, rows=256,
+                                         chunks_per_call=32)
+    check = double_sha512(nonce.to_bytes(8, "big") + ih)
+    assert int.from_bytes(check[:8], "big") <= target
+    assert trials > 0
+
+
+@requires_accelerator
 def test_dispatcher_batches_on_single_chip():
     from pybitmessage_tpu.pow import PowDispatcher
 

@@ -35,8 +35,17 @@ async def test_batch_verifier_device_path():
     v.start()
     try:
         objs = [_make_object(b"obj %d" % i) for i in range(4)]
-        bad = bytearray(objs[0])
-        bad[0] ^= 0xFF  # break the nonce
+        # Break the nonce — but at this tiny test difficulty a random
+        # nonce still PASSES with p ≈ target/2^64 ≈ 1/350 per run (the
+        # r2 flake), so re-corrupt until the host check agrees it's bad.
+        from pybitmessage_tpu.models.pow_math import check_pow
+        for flip in range(0xFF, 0, -1):
+            bad = bytearray(objs[0])
+            bad[0] ^= flip
+            if not check_pow(bytes(bad), NTPB, EXTRA, clamp=False):
+                break
+        else:  # pragma: no cover - p ≈ (1/350)^255
+            pytest.fail("every corruption accidentally passed PoW")
         results = await asyncio.gather(
             *(v.check(bytes(o)) for o in objs + [bytes(bad)]))
         assert results[:4] == [True] * 4
