@@ -2,12 +2,19 @@
 
 The reference's Qt4 frontend is ~9k lines of generated forms around the
 same core operations: inbox/sent lists, compose, identities, address
-book, subscriptions, network status (bitmessageqt/__init__.py).  This
-is the re-design on the stdlib toolkit (tkinter — PyQt/Kivy are not
-assumed installed): an RPC *client* like the TUI, sharing its tested
-``ViewModel`` fetch/action layer, with a notebook of panes, a reader,
-and compose/identity dialogs.  Auto-refreshes on a poll timer — the
-UISignal stream stays daemon-side; any frontend can attach/detach.
+book, blacklist, subscriptions, settings dialog, identicons, network
+status (bitmessageqt/__init__.py, blacklist.py, settings.py,
+qidenticon.py).  This is the re-design on the stdlib toolkit (tkinter —
+PyQt/Kivy are not assumed installed): an RPC *client* sharing the
+tested :mod:`viewmodel` layer, split so everything with behavior is
+headless-testable:
+
+- :class:`GUIController` — every callback's logic, driving the
+  ViewModel and an abstract view protocol (``set_status``,
+  ``show_error``, ``fill_list``, ``fill_text``).  Tested without a
+  display in tests/test_gui_controller.py.
+- :class:`BMApp` — the thin tkinter shell: builds widgets, implements
+  the view protocol, forwards events.  Only this needs ``$DISPLAY``.
 
 Usage:  python -m pybitmessage_tpu.gui --api-port 8442
 """
@@ -18,12 +25,188 @@ import argparse
 import sys
 
 from .cli import CommandError, RPCClient
-from .tui import ViewModel, _unb64
+from .core.i18n import install as i18n_install, tr
+from .utils.identicon import derive
+from .viewmodel import ViewModel, _unb64
 
 REFRESH_MS = 3000
 
+#: settings exposed in the dialog, in display order (reference
+#: bitmessageqt/settings.py covers the same groups: network, rates,
+#: demanded difficulty, adult content lists)
+SETTING_FIELDS = (
+    "port", "maxoutboundconnections", "maxtotalconnections",
+    "maxdownloadrate", "maxuploadrate", "dandelion", "ttl",
+    "blackwhitelist", "udp", "upnp", "tls", "powlanes", "powchunks",
+)
 
-class BMApp:  # pragma: no cover - needs a display; logic lives in ViewModel
+
+class GUIController:
+    """Widget-free GUI behavior over the shared ViewModel.
+
+    ``view`` implements: ``set_status(text)``, ``show_error(title,
+    text)``, ``fill_list(name, rows)``, ``fill_text(name, text)``.
+    Every method returns True on success so the shell knows whether to
+    close its dialog.
+    """
+
+    def __init__(self, vm: ViewModel, view):
+        self.vm = vm
+        self.view = view
+
+    # -- data ----------------------------------------------------------------
+
+    def refresh(self) -> bool:
+        try:
+            self.vm.refresh()
+        except CommandError as exc:
+            self.view.set_status(f"error: {exc}")
+            return False
+        vm = self.vm
+        self.view.fill_list("inbox", [
+            (m["fromAddress"], _unb64(m["subject"])) for m in vm.inbox])
+        self.view.fill_list("sent", [
+            (m["toAddress"], _unb64(m["subject"]), m["status"])
+            for m in vm.sent])
+        self.view.fill_list("identities", [
+            (a["address"], a["label"]) for a in vm.addresses])
+        self.view.fill_list("subscriptions", [
+            (s["address"], _unb64(s["label"])) for s in vm.subscriptions])
+        self.view.fill_list("addressbook", [
+            (e["address"], _unb64(e["label"])) for e in vm.addressbook])
+        self.view.fill_list("blacklist", [
+            (e["address"], _unb64(e["label"]),
+             "on" if e.get("enabled") else "off")
+            for e in vm.active_list])
+        self.view.fill_text("network", "\n".join(vm.render_network(120)))
+        self.view.set_status(tr(
+            "{inbox} inbox / {sent} sent / {mode}list mode",
+            inbox=len(vm.inbox), sent=len(vm.sent), mode=vm.list_mode))
+        return True
+
+    # -- messages ------------------------------------------------------------
+
+    def message_text(self, index: int) -> str:
+        return "\n".join(self.vm.render_message(index, 90))
+
+    def trash_selected(self, index: int) -> bool:
+        if index < 0:
+            return False
+        try:
+            self.vm.trash_inbox(index)
+        except CommandError as exc:
+            self.view.set_status(f"error: {exc}")
+            return False
+        return self.refresh()
+
+    def send(self, to: str, sender: str, subject: str, body: str) -> bool:
+        try:
+            ack = self.vm.send_message(to, sender, subject, body)
+        except CommandError as exc:
+            self.view.show_error(tr("send failed"), str(exc))
+            return False
+        self.view.set_status("queued %s…" % ack[:16])
+        return self.refresh()
+
+    # -- identities / address book / blacklist -------------------------------
+
+    def create_identity(self, label: str | None) -> bool:
+        if not label:
+            return False
+        try:
+            addr = self.vm.create_address(label)
+        except CommandError as exc:
+            self.view.show_error(tr("create failed"), str(exc))
+            return False
+        self.view.set_status("created %s" % addr)
+        return self.refresh()
+
+    def addressbook_add(self, address: str, label: str) -> bool:
+        try:
+            self.vm.addressbook_add(address, label)
+        except CommandError as exc:
+            self.view.show_error(tr("add failed"), str(exc))
+            return False
+        return self.refresh()
+
+    def addressbook_delete(self, index: int) -> bool:
+        if index < 0:
+            return False
+        try:
+            self.vm.addressbook_delete(index)
+        except CommandError as exc:
+            self.view.set_status(f"error: {exc}")
+            return False
+        return self.refresh()
+
+    def blacklist_add(self, address: str, label: str) -> bool:
+        try:
+            self.vm.blacklist_add(address, label)
+        except CommandError as exc:
+            self.view.show_error(tr("add failed"), str(exc))
+            return False
+        return self.refresh()
+
+    def blacklist_delete(self, index: int) -> bool:
+        if index < 0:
+            return False
+        try:
+            self.vm.blacklist_delete(index)
+        except CommandError as exc:
+            self.view.set_status(f"error: {exc}")
+            return False
+        return self.refresh()
+
+    def toggle_list_mode(self) -> bool:
+        try:
+            mode = self.vm.toggle_list_mode()
+        except CommandError as exc:
+            self.view.set_status(f"error: {exc}")
+            return False
+        self.view.set_status(tr("now in {mode}list mode", mode=mode))
+        return self.refresh()
+
+    # -- settings ------------------------------------------------------------
+
+    def load_settings(self) -> dict[str, str] | None:
+        """Current values for the dialog's editable fields, or None
+        when the daemon can't be reached (shell skips the dialog)."""
+        try:
+            self.vm.refresh_settings()
+        except CommandError as exc:
+            self.view.set_status(f"error: {exc}")
+            return None
+        return {k: str(self.vm.settings.get(k, ""))
+                for k in SETTING_FIELDS}
+
+    def save_settings(self, values: dict[str, str]) -> bool:
+        """Persist changed fields; collects per-field errors."""
+        before = {k: str(self.vm.settings.get(k, ""))
+                  for k in SETTING_FIELDS}
+        errors = []
+        for key, value in values.items():
+            if key not in SETTING_FIELDS or str(value) == before.get(key):
+                continue
+            try:
+                self.vm.update_setting(key, str(value))
+            except CommandError as exc:
+                errors.append(f"{key}: {exc}")
+        if errors:
+            self.view.show_error(tr("Settings"), "\n".join(errors))
+            return False
+        self.view.set_status(tr("settings saved"))
+        return True
+
+    # -- identicons ----------------------------------------------------------
+
+    @staticmethod
+    def identicon(address: str):
+        """(grid, '#rrggbb') for canvas renderers."""
+        icon = derive(address)
+        return icon.grid, "#%02x%02x%02x" % icon.color
+
+
+class BMApp:  # pragma: no cover - thin widget shell; logic is GUIController
     def __init__(self, rpc: RPCClient):
         import tkinter as tk
         from tkinter import messagebox, ttk
@@ -31,169 +214,239 @@ class BMApp:  # pragma: no cover - needs a display; logic lives in ViewModel
         self.tk = tk
         self.ttk = ttk
         self.messagebox = messagebox
-        self.vm = ViewModel(rpc)
+        self.ctl = GUIController(ViewModel(rpc), self)
 
         self.root = tk.Tk()
         self.root.title("pybitmessage-tpu")
-        self.root.geometry("900x560")
+        self.root.geometry("980x600")
 
         self.notebook = ttk.Notebook(self.root)
         self.notebook.pack(fill="both", expand=True)
 
-        self.inbox_list = self._make_list(
-            "Inbox", ("From", "Subject"), self._open_message)
-        self.sent_list = self._make_list(
-            "Sent", ("To", "Subject", "Status"))
-        self.addr_list = self._make_list(
-            "Identities", ("Address", "Label"))
-        self.subs_list = self._make_list(
-            "Subscriptions", ("Address", "Label"))
-        self.network_text = self._make_text_pane("Network")
+        self.lists = {}
+        self.texts = {}
+        self._pane_order = []  # tab index -> pane name, set on creation
+        self._icons = {}      # keep PhotoImage refs alive
+        self._make_list("inbox", tr("Inbox"),
+                        (tr("From"), tr("Subject")), self._open_message)
+        self._make_list("sent", tr("Sent"),
+                        (tr("To"), tr("Subject"), tr("Status")))
+        self._make_list("identities", tr("Identities"),
+                        (tr("Address"), tr("Label")), icons=True)
+        self._make_list("subscriptions", tr("Subscriptions"),
+                        (tr("Address"), tr("Label")))
+        self._make_list("addressbook", tr("Address book"),
+                        (tr("Address"), tr("Label")), icons=True)
+        self._make_list("blacklist", tr("Blacklist"),
+                        (tr("Address"), tr("Label"), tr("Status")))
+        self._make_text_pane("network", tr("Network"))
 
         bar = ttk.Frame(self.root)
         bar.pack(fill="x")
-        for label, cmd in (("New message", self.compose),
-                           ("New identity", self.new_identity),
-                           ("Trash selected", self.trash_selected),
-                           ("Refresh", self.refresh)):
+        for label, cmd in (
+                (tr("New message"), self._compose),
+                (tr("New identity"), self._new_identity),
+                (tr("Trash selected"), self._trash),
+                (tr("Add entry"), self._add_entry),
+                (tr("Remove entry"), self._remove_entry),
+                (tr("Toggle mode"), self.ctl.toggle_list_mode),
+                (tr("Settings"), self._settings_dialog),
+                (tr("Refresh"), self.ctl.refresh)):
             ttk.Button(bar, text=label, command=cmd).pack(
-                side="left", padx=4, pady=4)
+                side="left", padx=3, pady=4)
         self.status = tk.StringVar(value="ready")
         ttk.Label(bar, textvariable=self.status).pack(side="right", padx=6)
 
-    # -- widgets -------------------------------------------------------------
+    # -- view protocol (GUIController calls these) ---------------------------
 
-    def _make_list(self, title, columns, on_open=None):
-        frame = self.ttk.Frame(self.notebook)
-        self.notebook.add(frame, text=title)
-        tree = self.ttk.Treeview(frame, columns=columns, show="headings")
-        for c in columns:
-            tree.heading(c, text=c)
-        tree.pack(fill="both", expand=True)
-        if on_open:
-            tree.bind("<Double-1>", lambda e: on_open())
-        return tree
+    def set_status(self, text: str) -> None:
+        self.status.set(text)
 
-    def _make_text_pane(self, title):
-        frame = self.ttk.Frame(self.notebook)
-        self.notebook.add(frame, text=title)
-        text = self.tk.Text(frame, state="disabled")
-        text.pack(fill="both", expand=True)
-        return text
+    def show_error(self, title: str, text: str) -> None:
+        self.messagebox.showerror(title, text)
 
-    # -- data ----------------------------------------------------------------
-
-    def refresh(self):
-        try:
-            self.vm.refresh()
-        except CommandError as exc:
-            self.status.set(f"error: {exc}")
-            return
-        self._fill(self.inbox_list,
-                   [(m["fromAddress"], _unb64(m["subject"]))
-                    for m in self.vm.inbox])
-        self._fill(self.sent_list,
-                   [(m["toAddress"], _unb64(m["subject"]), m["status"])
-                    for m in self.vm.sent])
-        self._fill(self.addr_list,
-                   [(a["address"], a["label"]) for a in self.vm.addresses])
-        self._fill(self.subs_list,
-                   [(s["address"], _unb64(s["label"]))
-                    for s in self.vm.subscriptions])
-        self.network_text.configure(state="normal")
-        self.network_text.delete("1.0", "end")
-        self.network_text.insert(
-            "1.0", "\n".join(self.vm.render_network(120)))
-        self.network_text.configure(state="disabled")
-        self.status.set("%d inbox / %d sent" %
-                        (len(self.vm.inbox), len(self.vm.sent)))
-
-    def _fill(self, tree, rows):
-        # preserve the user's selection across the auto-refresh — a
-        # blind delete-all would clear it mid-interaction
+    def fill_list(self, name: str, rows) -> None:
+        tree = self.lists[name]
         keep = self._selected_index(tree)
         tree.delete(*tree.get_children())
         for row in rows:
-            tree.insert("", "end", values=row)
+            kw = {}
+            if tree._use_icons:
+                kw["image"] = self._identicon_image(row[0])
+            tree.insert("", "end", values=row, **kw)
         children = tree.get_children()
         if 0 <= keep < len(children):
             tree.selection_set(children[keep])
 
-    # -- actions -------------------------------------------------------------
+    def fill_text(self, name: str, text: str) -> None:
+        widget = self.texts[name]
+        widget.configure(state="normal")
+        widget.delete("1.0", "end")
+        widget.insert("1.0", text)
+        widget.configure(state="disabled")
+
+    # -- widgets -------------------------------------------------------------
+
+    def _make_list(self, name, title, columns, on_open=None, icons=False):
+        frame = self.ttk.Frame(self.notebook)
+        self.notebook.add(frame, text=title)
+        show = "tree headings" if icons else "headings"
+        tree = self.ttk.Treeview(frame, columns=columns, show=show)
+        if icons:
+            tree.column("#0", width=40, stretch=False)
+        for c in columns:
+            tree.heading(c, text=c)
+        tree.pack(fill="both", expand=True)
+        tree._use_icons = icons
+        if on_open:
+            tree.bind("<Double-1>", lambda e: on_open())
+        self.lists[name] = tree
+        self._pane_order.append(name)
+        return tree
+
+    def _make_text_pane(self, name, title):
+        frame = self.ttk.Frame(self.notebook)
+        self.notebook.add(frame, text=title)
+        text = self.tk.Text(frame, state="disabled")
+        text.pack(fill="both", expand=True)
+        self.texts[name] = text
+        self._pane_order.append(name)
+
+    def _identicon_image(self, address: str):
+        if address not in self._icons:
+            grid, color = self.ctl.identicon(address)
+            n = len(grid)
+            scale = 4
+            img = self.tk.PhotoImage(width=n * scale, height=n * scale)
+            img.put("white", to=(0, 0, n * scale, n * scale))
+            for r, row in enumerate(grid):
+                for c, cell in enumerate(row):
+                    if cell:
+                        img.put(color, to=(c * scale, r * scale,
+                                           (c + 1) * scale,
+                                           (r + 1) * scale))
+            self._icons[address] = img
+        return self._icons[address]
+
+    # -- event handlers (delegate to controller) -----------------------------
 
     def _selected_index(self, tree) -> int:
         sel = tree.selection()
         return tree.index(sel[0]) if sel else -1
 
+    def _current_pane(self) -> str:
+        # order recorded as panes were created — no second hardcoded
+        # list to drift out of sync with __init__
+        return self._pane_order[self.notebook.index(self.notebook.select())]
+
     def _open_message(self):
-        i = self._selected_index(self.inbox_list)
+        i = self._selected_index(self.lists["inbox"])
         if i < 0:
             return
         win = self.tk.Toplevel(self.root)
-        win.title("Message")
+        win.title(tr("Message"))
         text = self.tk.Text(win, width=90, height=30)
         text.pack(fill="both", expand=True)
-        text.insert("1.0", "\n".join(self.vm.render_message(i, 90)))
+        text.insert("1.0", self.ctl.message_text(i))
         text.configure(state="disabled")
 
-    def trash_selected(self):
-        i = self._selected_index(self.inbox_list)
-        if i < 0:
-            return
-        try:
-            self.vm.trash_inbox(i)
-        except CommandError as exc:
-            self.status.set(f"error: {exc}")
-            return
-        self.refresh()
+    def _trash(self):
+        self.ctl.trash_selected(self._selected_index(self.lists["inbox"]))
 
-    def compose(self):
+    def _compose(self):
         win = self.tk.Toplevel(self.root)
-        win.title("New message")
+        win.title(tr("New message"))
         fields = {}
-        for row, name in enumerate(("To", "From", "Subject")):
+        for row, name in enumerate((tr("To"), tr("From"), tr("Subject"))):
             self.ttk.Label(win, text=name).grid(row=row, column=0,
                                                 sticky="e")
             e = self.ttk.Entry(win, width=70)
             e.grid(row=row, column=1, padx=4, pady=2)
-            fields[name] = e
+            fields[row] = e
         body = self.tk.Text(win, width=70, height=14)
         body.grid(row=3, column=1, padx=4, pady=4)
 
         def send():
-            try:
-                ack = self.vm.send_message(
-                    fields["To"].get(), fields["From"].get(),
-                    fields["Subject"].get(), body.get("1.0", "end-1c"))
-                self.status.set("queued %s…" % ack[:16])
+            if self.ctl.send(fields[0].get(), fields[1].get(),
+                             fields[2].get(), body.get("1.0", "end-1c")):
                 win.destroy()
-                self.refresh()
-            except CommandError as exc:
-                self.messagebox.showerror("send failed", str(exc))
 
-        self.ttk.Button(win, text="Send", command=send).grid(
+        self.ttk.Button(win, text=tr("Send"), command=send).grid(
             row=4, column=1, sticky="e", padx=4, pady=4)
 
-    def new_identity(self):
+    def _new_identity(self):
         from tkinter.simpledialog import askstring
-        label = askstring("New identity", "Label:")
-        if label is None:
+        self.ctl.create_identity(askstring(tr("New identity"),
+                                           tr("Label") + ":"))
+
+    def _entry_dialog(self, title, callback):
+        win = self.tk.Toplevel(self.root)
+        win.title(title)
+        entries = []
+        for row, name in enumerate((tr("Address"), tr("Label"))):
+            self.ttk.Label(win, text=name).grid(row=row, column=0,
+                                                sticky="e")
+            e = self.ttk.Entry(win, width=50)
+            e.grid(row=row, column=1, padx=4, pady=2)
+            entries.append(e)
+
+        def add():
+            if callback(entries[0].get(), entries[1].get()):
+                win.destroy()
+
+        self.ttk.Button(win, text=tr("Add"), command=add).grid(
+            row=2, column=1, sticky="e", padx=4, pady=4)
+
+    def _add_entry(self):
+        pane = self._current_pane()
+        if pane == "blacklist":
+            self._entry_dialog(tr("Blacklist"), self.ctl.blacklist_add)
+        else:
+            self._entry_dialog(tr("Address book"),
+                               self.ctl.addressbook_add)
+
+    def _remove_entry(self):
+        pane = self._current_pane()
+        if pane == "blacklist":
+            self.ctl.blacklist_delete(
+                self._selected_index(self.lists["blacklist"]))
+        elif pane == "addressbook":
+            self.ctl.addressbook_delete(
+                self._selected_index(self.lists["addressbook"]))
+
+    def _settings_dialog(self):
+        values = self.ctl.load_settings()
+        if values is None:
             return
-        try:
-            addr = self.vm.create_address(label)
-        except CommandError as exc:
-            self.messagebox.showerror("create failed", str(exc))
-            return
-        self.status.set("created %s" % addr)
-        self.refresh()
+        win = self.tk.Toplevel(self.root)
+        win.title(tr("Settings"))
+        entries = {}
+        for row, key in enumerate(values):
+            self.ttk.Label(win, text=key).grid(row=row, column=0,
+                                               sticky="e", padx=4)
+            e = self.ttk.Entry(win, width=30)
+            e.insert(0, values[key])
+            e.grid(row=row, column=1, padx=4, pady=1)
+            entries[key] = e
+        backends = ", ".join(self.ctl.vm.settings.get("powBackends", []))
+        self.ttk.Label(win, text="PoW backends: " + backends).grid(
+            row=len(values), column=0, columnspan=2, pady=4)
+
+        def save():
+            if self.ctl.save_settings(
+                    {k: e.get() for k, e in entries.items()}):
+                win.destroy()
+
+        self.ttk.Button(win, text=tr("Save"), command=save).grid(
+            row=len(values) + 1, column=1, sticky="e", padx=4, pady=4)
 
     # -- main loop -----------------------------------------------------------
 
     def run(self) -> int:
-        self.refresh()
+        self.ctl.refresh()
 
         def tick():
-            self.refresh()
+            self.ctl.refresh()
             self.root.after(REFRESH_MS, tick)
 
         self.root.after(REFRESH_MS, tick)
@@ -207,7 +460,10 @@ def main(argv=None) -> int:  # pragma: no cover - needs a display
     p.add_argument("--api-port", type=int, default=8442)
     p.add_argument("--api-user", default="")
     p.add_argument("--api-password", default="")
+    p.add_argument("--lang", default=None,
+                   help="UI language (e.g. 'de'); default from $LANG")
     args = p.parse_args(argv)
+    i18n_install(args.lang)
     rpc = RPCClient(args.api_host, args.api_port, args.api_user,
                     args.api_password)
     return BMApp(rpc).run()

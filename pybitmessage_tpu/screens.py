@@ -1,0 +1,108 @@
+"""Declarative mobile screen registry over the shared ViewModel.
+
+Role model: the reference's Kivy frontend is driven by a declarative
+``screens_data.json`` mapping screen names to kv layouts and per-screen
+classes (src/bitmessagekivy/screens_data.json + mpybit.py, developed
+against a mock backend, src/mock/class_addressGenerator.py:18-40).
+Kivy itself is not installable in this environment, so the mobile role
+is filled framework-agnostically: ``screens.json`` declares every
+screen (list/status/form), its renderer, its detail view, its actions
+and its submit form — all bound BY NAME to :class:`viewmodel.ViewModel`
+methods and validated at load time.  A toolkit shell (Kivy included,
+when available) can build its whole navigation mechanically from this
+registry, exactly like the reference's ScreenManager does; the test
+suite drives every screen against a live node instead of a mock.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from pathlib import Path
+
+from .core.i18n import tr
+from .viewmodel import ViewModel
+
+REGISTRY_PATH = Path(__file__).resolve().parent / "screens.json"
+
+
+class ScreenError(ValueError):
+    """Registry references a binding the ViewModel does not provide."""
+
+
+@dataclass
+class Screen:
+    """One resolved screen: callables bound to a live ViewModel."""
+    name: str
+    title: str
+    kind: str                      # list | status | form
+    render: object = None          # fn(width) -> list[str]
+    detail: object = None          # fn(index, width) -> list[str]
+    actions: dict = field(default_factory=dict)   # name -> fn(...)
+    form_fields: tuple = ()
+    submit: object = None          # fn(*fields) -> str
+
+    @property
+    def label(self) -> str:
+        return tr(self.title)
+
+
+def load_registry(path: Path | None = None) -> dict:
+    """Raw registry (comment keys stripped)."""
+    data = json.loads((path or REGISTRY_PATH).read_text())
+    return {k: v for k, v in data.items() if not k.startswith("_")}
+
+
+def bind(vm: ViewModel, path: Path | None = None) -> dict[str, Screen]:
+    """Resolve every screen's bindings against ``vm``, validating that
+    each named method exists — a broken registry fails at startup, not
+    when the user taps the screen."""
+
+    def resolve(spec: dict, key: str, screen: str):
+        name = spec.get(key)
+        if name is None:
+            return None
+        fn = getattr(vm, name, None)
+        if not callable(fn):
+            raise ScreenError(
+                "screen %r binds %s=%r which ViewModel lacks"
+                % (screen, key, name))
+        return fn
+
+    screens: dict[str, Screen] = {}
+    for name, spec in load_registry(path).items():
+        kind = spec.get("kind", "list")
+        if kind not in ("list", "status", "form"):
+            raise ScreenError("screen %r has unknown kind %r"
+                              % (name, kind))
+        actions = {}
+        for act, target in spec.get("actions", {}).items():
+            fn = getattr(vm, target, None)
+            if not callable(fn):
+                raise ScreenError(
+                    "screen %r action %r binds %r which ViewModel lacks"
+                    % (name, act, target))
+            actions[act] = fn
+        form = spec.get("form", {})
+        submit = None
+        if form:
+            submit = getattr(vm, form.get("submit", ""), None)
+            if not callable(submit):
+                raise ScreenError(
+                    "screen %r form submit %r missing on ViewModel"
+                    % (name, form.get("submit")))
+        screens[name] = Screen(
+            name=name, title=spec.get("title", name), kind=kind,
+            render=resolve(spec, "render", name),
+            detail=resolve(spec, "detail", name),
+            actions=actions,
+            form_fields=tuple(form.get("fields", ())),
+            submit=submit)
+    return screens
+
+
+def navigation(screens: dict[str, Screen]) -> list[tuple[str, str]]:
+    """(name, localized label) pairs in registry order — the nav
+    drawer any shell renders (reference mpybit.py builds its
+    NavigationDrawer the same mechanical way)."""
+    return [(s.name, s.label) for s in screens.values()]

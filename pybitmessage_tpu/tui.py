@@ -5,12 +5,14 @@ The reference ships a dialog-based curses frontend
 global queues.  This one is an API *client* over JSON-RPC — any running
 daemon can be attached to — and is split into:
 
-- a pure view-model layer (fetch + render functions returning plain
-  text lines) that the test suite covers without a terminal, and
+- the shared, headless-tested :mod:`viewmodel` layer (fetch + render
+  functions returning plain text lines), and
 - a thin curses shell (`run`) holding only keyboard/paint logic.
 
 Keys: Tab switch panes; j/k or arrows move; Enter read; t trash;
-n new message; b new broadcast; a new address; r refresh; q quit.
+n new message; b new broadcast; a new address; + add entry (address
+book / blacklist); x delete entry; m toggle black/white mode;
+r refresh; q quit.
 
 Usage:  python -m pybitmessage_tpu.tui --api-port 8442
 """
@@ -18,149 +20,11 @@ Usage:  python -m pybitmessage_tpu.tui --api-port 8442
 from __future__ import annotations
 
 import argparse
-import base64
-import json
 import sys
 
 from .cli import RPCClient, CommandError
-
-PANES = ("Inbox", "Sent", "Addresses", "Subscriptions", "Network")
-
-
-def _unb64(s: str) -> str:
-    return base64.b64decode(s).decode("utf-8", "replace")
-
-
-def _b64(s: str) -> str:
-    return base64.b64encode(s.encode()).decode()
-
-
-def _clip(s: str, width: int) -> str:
-    return s[:width - 1] if width > 0 else ""
-
-
-# --- view model -------------------------------------------------------------
-
-class ViewModel:
-    """Fetches API state and renders each pane to plain text lines."""
-
-    def __init__(self, rpc: RPCClient):
-        self.rpc = rpc
-        self.inbox: list[dict] = []
-        self.sent: list[dict] = []
-        self.addresses: list[dict] = []
-        self.subscriptions: list[dict] = []
-        self.status: dict = {}
-
-    def refresh(self) -> None:
-        self.inbox = json.loads(
-            self.rpc.call("getAllInboxMessages"))["inboxMessages"]
-        self.sent = json.loads(
-            self.rpc.call("getAllSentMessages"))["sentMessages"]
-        self.addresses = json.loads(
-            self.rpc.call("listAddresses"))["addresses"]
-        self.subscriptions = json.loads(
-            self.rpc.call("listSubscriptions"))["subscriptions"]
-        self.status = json.loads(self.rpc.call("clientStatus"))
-
-    # -- renderers (pure) ----------------------------------------------------
-
-    def render_pane(self, pane: str, width: int) -> list[str]:
-        if pane == "Inbox":
-            return self.render_inbox(width)
-        if pane == "Sent":
-            return self.render_sent(width)
-        if pane == "Addresses":
-            return self.render_addresses(width)
-        if pane == "Subscriptions":
-            return self.render_subscriptions(width)
-        return self.render_network(width)
-
-    def render_inbox(self, width: int) -> list[str]:
-        if not self.inbox:
-            return ["(inbox empty)"]
-        return [_clip(
-            f"{'  ' if m.get('read') else '* '}"
-            f"{_unb64(m['subject']):30.30s}  "
-            f"{m['fromAddress']:40.40s} -> {m['toAddress']}", width)
-            for m in self.inbox]
-
-    def render_sent(self, width: int) -> list[str]:
-        if not self.sent:
-            return ["(nothing sent)"]
-        return [_clip(
-            f"{m['status']:22.22s} {_unb64(m['subject']):30.30s} "
-            f"-> {m['toAddress']}", width) for m in self.sent]
-
-    def render_addresses(self, width: int) -> list[str]:
-        if not self.addresses:
-            return ["(no identities — press 'a' to create one)"]
-        return [_clip(
-            f"{a['address']:42.42s} [{a['label']}]"
-            + ("  (chan)" if a.get("chan") else ""), width)
-            for a in self.addresses]
-
-    def render_subscriptions(self, width: int) -> list[str]:
-        if not self.subscriptions:
-            return ["(no subscriptions)"]
-        return [_clip(f"{s['address']:42.42s} [{_unb64(s['label'])}]",
-                      width) for s in self.subscriptions]
-
-    def render_network(self, width: int) -> list[str]:
-        s = self.status
-        if not s:
-            return ["(no status)"]
-        return [_clip(line, width) for line in (
-            f"network status:    {s.get('networkStatus', '?')}",
-            f"connections:       {s.get('networkConnections', 0)}",
-            f"messages processed:   {s.get('numberOfMessagesProcessed', 0)}",
-            f"broadcasts processed: "
-            f"{s.get('numberOfBroadcastsProcessed', 0)}",
-            f"pubkeys processed:    {s.get('numberOfPubkeysProcessed', 0)}",
-            f"PoW backend:       {s.get('powBackend', '?')}",
-        )]
-
-    def render_message(self, index: int, width: int) -> list[str]:
-        """Full view of inbox message ``index``."""
-        if not (0 <= index < len(self.inbox)):
-            return ["(no message selected)"]
-        m = self.inbox[index]
-        # mark read server-side the way the reference UI does
-        try:
-            self.rpc.call("getInboxMessageById", m["msgid"], True)
-        except CommandError:
-            pass
-        body = _unb64(m["message"])
-        lines = [
-            f"From:    {m['fromAddress']}",
-            f"To:      {m['toAddress']}",
-            f"Subject: {_unb64(m['subject'])}",
-            "",
-        ]
-        for para in body.splitlines() or [""]:
-            while len(para) >= width:
-                lines.append(para[:width - 1])
-                para = para[width - 1:]
-            lines.append(para)
-        return [_clip(ln, width) for ln in lines]
-
-    # -- actions -------------------------------------------------------------
-
-    def trash_inbox(self, index: int) -> None:
-        if 0 <= index < len(self.inbox):
-            self.rpc.call("trashMessage", self.inbox[index]["msgid"])
-
-    def send_message(self, to: str, sender: str, subject: str,
-                     body: str) -> str:
-        return self.rpc.call("sendMessage", to, sender, _b64(subject),
-                             _b64(body))
-
-    def send_broadcast(self, sender: str, subject: str, body: str) -> str:
-        return self.rpc.call("sendBroadcast", sender, _b64(subject),
-                             _b64(body))
-
-    def create_address(self, label: str) -> str:
-        return self.rpc.call("createRandomAddress", _b64(label))
+from .core.i18n import install as i18n_install
+from .viewmodel import PANES, ViewModel, _b64, _clip, _unb64  # noqa: F401
 
 
 def render_frame(vm: ViewModel, pane: str, selected: int, width: int,
@@ -201,7 +65,7 @@ def run(rpc: RPCClient) -> int:  # pragma: no cover - needs a tty
         pane_i, selected = 0, 0
         message_index = None
         status_line = "r refresh  n new  b broadcast  a address  " \
-            "t trash  Enter read  Tab pane  q quit"
+            "+ add  x del  m mode  t trash  Enter read  Tab pane  q quit"
         while True:
             stdscr.erase()
             h, w = stdscr.getmaxyx()
@@ -254,6 +118,32 @@ def run(rpc: RPCClient) -> int:  # pragma: no cover - needs a tty
                 label = prompt(stdscr, "Label: ")
                 vm.create_address(label)
                 vm.refresh()
+            elif key == ord("+") and pane in ("Addressbook", "Blacklist"):
+                try:
+                    address = prompt(stdscr, "Address: ")
+                    label = prompt(stdscr, "Label: ")
+                    if pane == "Addressbook":
+                        vm.addressbook_add(address, label)
+                    else:
+                        vm.blacklist_add(address, label)
+                    vm.refresh()
+                except CommandError as exc:
+                    status_line = f"error: {exc}"
+            elif key == ord("x") and pane in ("Addressbook", "Blacklist"):
+                try:
+                    if pane == "Addressbook":
+                        vm.addressbook_delete(selected)
+                    else:
+                        vm.blacklist_delete(selected - 1)  # row 0 = header
+                    vm.refresh()
+                except CommandError as exc:
+                    status_line = f"error: {exc}"
+            elif key == ord("m") and pane == "Blacklist":
+                try:
+                    vm.toggle_list_mode()
+                    vm.refresh()
+                except CommandError as exc:
+                    status_line = f"error: {exc}"
             elif key == ord("r"):
                 vm.refresh()
 
@@ -266,7 +156,10 @@ def main(argv=None) -> int:  # pragma: no cover - needs a tty
     p.add_argument("--api-port", type=int, default=8442)
     p.add_argument("--api-user", default="")
     p.add_argument("--api-password", default="")
+    p.add_argument("--lang", default=None,
+                   help="UI language (e.g. 'de'); default from $LANG")
     args = p.parse_args(argv)
+    i18n_install(args.lang)
     return run(RPCClient(args.api_host, args.api_port, args.api_user,
                          args.api_password))
 

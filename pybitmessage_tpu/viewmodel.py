@@ -1,0 +1,223 @@
+"""Shared frontend state layer: fetch + render + actions over the RPC API.
+
+Every frontend (curses TUI, tkinter GUI, the declarative mobile screen
+registry) drives this one tested ViewModel instead of talking to the
+API directly — the analog of the reference's pattern where all three
+UIs consume the same queue/SQL vocabulary (bitmessageqt/,
+bitmessagecurses/, bitmessagekivy/ all sit on UISignalQueue + helper_*
+functions).  Strings route through :mod:`core.i18n` so catalogs apply
+to every frontend at once.
+"""
+
+from __future__ import annotations
+
+import json
+
+from .cli import CommandError, RPCClient, _b64, _unb64
+from .core.i18n import tr
+from .utils.identicon import derive, render_compact
+
+PANES = ("Inbox", "Sent", "Identities", "Subscriptions", "Addressbook",
+         "Blacklist", "Network")
+
+
+def _clip(s: str, width: int) -> str:
+    return s[:width - 1] if width > 0 else ""
+
+
+class ViewModel:
+    """Fetches API state and renders each pane to plain text lines."""
+
+    def __init__(self, rpc: RPCClient):
+        self.rpc = rpc
+        self.inbox: list[dict] = []
+        self.sent: list[dict] = []
+        self.addresses: list[dict] = []
+        self.subscriptions: list[dict] = []
+        self.addressbook: list[dict] = []
+        self.blacklist: list[dict] = []
+        self.whitelist: list[dict] = []
+        self.list_mode: str = "black"
+        self.settings: dict = {}
+        self.status: dict = {}
+
+    def refresh(self) -> None:
+        self.inbox = json.loads(
+            self.rpc.call("getAllInboxMessages"))["inboxMessages"]
+        self.sent = json.loads(
+            self.rpc.call("getAllSentMessages"))["sentMessages"]
+        self.addresses = json.loads(
+            self.rpc.call("listAddresses"))["addresses"]
+        self.subscriptions = json.loads(
+            self.rpc.call("listSubscriptions"))["subscriptions"]
+        self.addressbook = json.loads(
+            self.rpc.call("listAddressBookEntries"))["addresses"]
+        self.blacklist = json.loads(
+            self.rpc.call("listBlacklistEntries"))["blacklist"]
+        self.whitelist = json.loads(
+            self.rpc.call("listWhitelistEntries"))["whitelist"]
+        self.list_mode = self.rpc.call("getBlackWhitelistMode")
+        self.status = json.loads(self.rpc.call("clientStatus"))
+
+    def refresh_settings(self) -> None:
+        """Settings fetched on demand (the dialog), not every poll."""
+        self.settings = json.loads(self.rpc.call("getSettings"))
+
+    # -- renderers (pure) ----------------------------------------------------
+
+    def render_pane(self, pane: str, width: int) -> list[str]:
+        return {
+            "Inbox": self.render_inbox,
+            "Sent": self.render_sent,
+            "Identities": self.render_addresses,
+            "Addresses": self.render_addresses,     # legacy pane name
+            "Subscriptions": self.render_subscriptions,
+            "Addressbook": self.render_addressbook,
+            "Blacklist": self.render_blacklist,
+        }.get(pane, self.render_network)(width)
+
+    def render_inbox(self, width: int) -> list[str]:
+        if not self.inbox:
+            return ["(" + tr("inbox empty") + ")"]
+        return [_clip(
+            f"{'  ' if m.get('read') else '* '}"
+            f"{_unb64(m['subject']):30.30s}  "
+            f"{m['fromAddress']:40.40s} -> {m['toAddress']}", width)
+            for m in self.inbox]
+
+    def render_sent(self, width: int) -> list[str]:
+        if not self.sent:
+            return ["(" + tr("nothing sent") + ")"]
+        return [_clip(
+            f"{m['status']:22.22s} {_unb64(m['subject']):30.30s} "
+            f"-> {m['toAddress']}", width) for m in self.sent]
+
+    def render_addresses(self, width: int) -> list[str]:
+        if not self.addresses:
+            return ["(" + tr("no identities — press 'a' to create one")
+                    + ")"]
+        return [_clip(
+            f"{a['address']:42.42s} [{a['label']}]"
+            + ("  (chan)" if a.get("chan") else ""), width)
+            for a in self.addresses]
+
+    def render_subscriptions(self, width: int) -> list[str]:
+        if not self.subscriptions:
+            return ["(" + tr("no subscriptions") + ")"]
+        return [_clip(f"{s['address']:42.42s} [{_unb64(s['label'])}]",
+                      width) for s in self.subscriptions]
+
+    def render_addressbook(self, width: int) -> list[str]:
+        if not self.addressbook:
+            return ["(" + tr("address book empty") + ")"]
+        return [_clip(f"{e['address']:42.42s} [{_unb64(e['label'])}]",
+                      width) for e in self.addressbook]
+
+    @property
+    def active_list(self) -> list[dict]:
+        """Rows of the table the current mode actually enforces — the
+        reference's blacklist tab switches tables with the mode the
+        same way (bitmessageqt/blacklist.py)."""
+        return self.whitelist if self.list_mode == "white" else \
+            self.blacklist
+
+    def render_blacklist(self, width: int) -> list[str]:
+        header = tr("mode: {mode}", mode=self.list_mode + "list")
+        rows = self.active_list
+        if not rows:
+            return [header, "(" + tr("list empty") + ")"]
+        return [header] + [_clip(
+            f"{'on ' if e.get('enabled') else 'off'} "
+            f"{e['address']:42.42s} [{_unb64(e['label'])}]", width)
+            for e in rows]
+
+    def render_network(self, width: int) -> list[str]:
+        s = self.status
+        if not s:
+            return ["(no status)"]
+        return [_clip(line, width) for line in (
+            f"network status:    {s.get('networkStatus', '?')}",
+            f"connections:       {s.get('networkConnections', 0)}",
+            f"messages processed:   {s.get('numberOfMessagesProcessed', 0)}",
+            f"broadcasts processed: "
+            f"{s.get('numberOfBroadcastsProcessed', 0)}",
+            f"pubkeys processed:    {s.get('numberOfPubkeysProcessed', 0)}",
+            f"PoW backend:       {s.get('powBackend', '?')}",
+        )]
+
+    def render_message(self, index: int, width: int) -> list[str]:
+        """Full view of inbox message ``index``, identicon included."""
+        if not (0 <= index < len(self.inbox)):
+            return ["(no message selected)"]
+        m = self.inbox[index]
+        # mark read server-side the way the reference UI does
+        try:
+            self.rpc.call("getInboxMessageById", m["msgid"], True)
+        except CommandError:
+            pass
+        body = _unb64(m["message"])
+        icon = render_compact(derive(m["fromAddress"])).splitlines()
+        lines = [
+            f"{icon[0]}  {tr('From')}:    {m['fromAddress']}",
+            f"{icon[1]}  {tr('To')}:      {m['toAddress']}",
+            f"{icon[2]}  {tr('Subject')}: {_unb64(m['subject'])}",
+            f"{icon[3]}",
+        ]
+        for para in body.splitlines() or [""]:
+            while len(para) >= width:
+                lines.append(para[:width - 1])
+                para = para[width - 1:]
+            lines.append(para)
+        return [_clip(ln, width) for ln in lines]
+
+    # -- actions -------------------------------------------------------------
+
+    def trash_inbox(self, index: int) -> None:
+        if 0 <= index < len(self.inbox):
+            self.rpc.call("trashMessage", self.inbox[index]["msgid"])
+
+    def send_message(self, to: str, sender: str, subject: str,
+                     body: str) -> str:
+        return self.rpc.call("sendMessage", to, sender, _b64(subject),
+                             _b64(body))
+
+    def send_broadcast(self, sender: str, subject: str, body: str) -> str:
+        return self.rpc.call("sendBroadcast", sender, _b64(subject),
+                             _b64(body))
+
+    def create_address(self, label: str) -> str:
+        return self.rpc.call("createRandomAddress", _b64(label))
+
+    def addressbook_add(self, address: str, label: str) -> str:
+        return self.rpc.call("addAddressBookEntry", address, _b64(label))
+
+    def addressbook_delete(self, index: int) -> None:
+        if 0 <= index < len(self.addressbook):
+            self.rpc.call("deleteAddressBookEntry",
+                          self.addressbook[index]["address"])
+
+    def blacklist_add(self, address: str, label: str) -> str:
+        """Add to the table the current mode enforces (whitelist rows
+        while in 'white' mode — otherwise the user's additions would
+        land in the table the processor is ignoring)."""
+        cmd = "addWhitelistEntry" if self.list_mode == "white" \
+            else "addBlacklistEntry"
+        return self.rpc.call(cmd, address, _b64(label))
+
+    def blacklist_delete(self, index: int) -> None:
+        # row 0 of the rendered pane is the mode header; callers pass
+        # the DATA index (pane index - 1)
+        rows = self.active_list
+        if 0 <= index < len(rows):
+            cmd = "deleteWhitelistEntry" if self.list_mode == "white" \
+                else "deleteBlacklistEntry"
+            self.rpc.call(cmd, rows[index]["address"])
+
+    def toggle_list_mode(self) -> str:
+        mode = "white" if self.list_mode == "black" else "black"
+        self.rpc.call("setBlackWhitelistMode", mode)
+        self.list_mode = mode
+        return mode
+
+    def update_setting(self, key: str, value: str) -> str:
+        return self.rpc.call("updateSetting", key, value)
