@@ -10,10 +10,14 @@ Methodology (honest-timing rules):
   the remote-execution relay);
 - median of repeated runs, not best-of;
 - the production single-chip kernel is benched: the Pallas/Mosaic
-  kernel at (256 rows x 512 chunks) = 16.7M trials/slab, 84.6 MH/s
-  measured, with the XLA windowed kernel (2^19 lanes x 64 chunks,
-  25.8 MH/s) as fallback + secondary datapoint.  Small slabs are
-  dispatch-latency bound (see BASELINE.md).
+  kernel at (128 rows x 512 chunks x unroll 4) = 33.5M trials/slab,
+  136.4 MH/s measured (BASELINE.md "Arithmetic utilization"), with the
+  XLA windowed kernel (2^19 lanes x 64 chunks, 25.8 MH/s) as fallback
+  + secondary datapoint.  Small slabs are dispatch-latency bound.
+- beyond the headline rate, the ONE output line carries a "configs"
+  object covering BASELINE.json's config list (single default-
+  difficulty object, mixed batch queue, ntpb x64 TTL=28d, broadcast
+  storm, pod-sharded tier) — sampled sizes are labeled as such.
 
 ``vs_baseline`` follows the reference's safe-PoW analog: a single-core
 hashlib double-SHA512 loop (src/proofofwork.py:157-171).  The JSON also
@@ -88,21 +92,23 @@ def _device_rate_pallas(initial_hash: bytes) -> float:
     import numpy as np
 
     from pybitmessage_tpu.ops.sha512_pallas import (
-        DEFAULT_CHUNKS, DEFAULT_ROWS, LANE_COLS, pallas_search)
+        DEFAULT_CHUNKS, DEFAULT_ROWS, DEFAULT_UNROLL, LANE_COLS,
+        pallas_search)
 
     words = [int.from_bytes(initial_hash[i:i + 8], "big")
              for i in range(0, 64, 8)]
     ih_words = jnp.array([[w >> 32, w & 0xFFFFFFFF] for w in words],
                          dtype=jnp.uint32)
     target = jnp.array([0, 1], dtype=jnp.uint32)   # unreachable
-    trials = DEFAULT_ROWS * LANE_COLS * DEFAULT_CHUNKS
+    trials = DEFAULT_ROWS * LANE_COLS * DEFAULT_CHUNKS * DEFAULT_UNROLL
 
     def run(start: int) -> float:
         base = jnp.array([(start >> 32) & 0xFFFFFFFF,
                           start & 0xFFFFFFFF], dtype=jnp.uint32)
         t0 = time.perf_counter()
         found, _ = pallas_search(ih_words, base, target,
-                                 rows=DEFAULT_ROWS, chunks=DEFAULT_CHUNKS)
+                                 rows=DEFAULT_ROWS, chunks=DEFAULT_CHUNKS,
+                                 unroll=DEFAULT_UNROLL)
         np.asarray(found)             # host pull forces completion
         return trials / (time.perf_counter() - t0)
 
@@ -110,35 +116,260 @@ def _device_rate_pallas(initial_hash: bytes) -> float:
     return statistics.median(run((i + 1) * trials) for i in range(REPS))
 
 
+def _device_rate_effective(initial_hash: bytes) -> float:
+    """Effective rate of the production double-buffered ``solve()``
+    loop (one slab in flight ahead of harvest): trials completed per
+    wall-second with an unreachable target and a fixed slab budget.
+    This is what a caller actually gets; it exceeds the synchronous
+    slab rate because dispatch/transfer gaps hide behind compute
+    (through the axon relay the gap is large — see BASELINE.md)."""
+    from pybitmessage_tpu.ops.pow_search import PowInterrupted
+    from pybitmessage_tpu.ops.sha512_pallas import (
+        DEFAULT_CHUNKS, DEFAULT_ROWS, DEFAULT_UNROLL, LANE_COLS, solve)
+
+    slab = DEFAULT_ROWS * LANE_COLS * DEFAULT_CHUNKS * DEFAULT_UNROLL
+    calls = {"n": 0}
+
+    def run(budget: int, start: int) -> float:
+        calls["n"] = 0
+
+        def stop():
+            calls["n"] += 1
+            return calls["n"] > budget
+
+        t0 = time.perf_counter()
+        try:
+            solve(initial_hash, 1, start_nonce=start, should_stop=stop)
+        except PowInterrupted:
+            pass
+        return budget * slab / (time.perf_counter() - t0)
+
+    run(1, 0)                                 # warm
+    return statistics.median(run(6, (i + 1) << 40) for i in range(3))
+
+
 def _device_rate(initial_hash: bytes) -> tuple[float, float, str]:
     """(best_rate, xla_rate, primary_kernel_name)."""
     xla = _device_rate_xla(initial_hash)
-    try:
-        pallas = _device_rate_pallas(initial_hash)
-    except Exception:
+    pallas = None
+    for attempt in range(2):       # transient relay/claim errors retry
+        try:
+            pallas = _device_rate_pallas(initial_hash)
+            break
+        except Exception:
+            import traceback
+            traceback.print_exc(file=sys.stderr)
+            if attempt == 0:       # wait only between attempts
+                time.sleep(20)
+    if pallas is None:
         return xla, xla, "xla-windowed"
     if pallas > xla:
         return pallas, xla, "pallas"
     return xla, xla, "xla-windowed"
 
 
+# -- BASELINE.json config benchmarks -----------------------------------------
+# The driver's config list (BASELINE.json "configs") beyond the raw
+# single-object rate.  Sizes are sampled down so the whole bench stays
+# minutes, and every scaled run is labeled with its sampling; the
+# full-size figures are the measured per-object wall-clocks times the
+# config's object count (PoW objects are independent).
+
+def _default_target(length: int, ttl: int, ntpb: int = 1000,
+                    extra: int = 1000) -> int:
+    from pybitmessage_tpu.models.pow_math import pow_target
+    return pow_target(length, ttl, ntpb, extra, clamp=False)
+
+
+def _mean_trials(length: int, ttl: int, ntpb: int = 1000,
+                 extra: int = 1000) -> float:
+    return 2.0 ** 64 / _default_target(length, ttl, ntpb, extra)
+
+
+def _bench_single_default(device_rate: float) -> dict:
+    """Config 1: one 1 kB msg object at network default difficulty
+    (nonceTrialsPerByte=1000, TTL=4 d) — REAL solves, plus the implied
+    mean from the measured hash rate (solve time is exponentially
+    distributed, so two samples + the implied mean tell more than
+    either alone)."""
+    from pybitmessage_tpu.ops.sha512_pallas import solve
+
+    ttl = 4 * 24 * 3600
+    length = 1008 + 8
+    target = _default_target(length, ttl)
+    solve(hashlib.sha512(b"bench warm").digest(), target)   # absorb
+    times = []                    # compile/relay-stall on the warmup
+    for i in range(3):
+        ih = hashlib.sha512(b"bench single %d" % i).digest()
+        t0 = time.perf_counter()
+        solve(ih, target)
+        times.append(time.perf_counter() - t0)
+    return {
+        "measured_solve_s": [round(t, 2) for t in times],
+        "median_solve_s": round(statistics.median(times), 2),
+        "implied_mean_s": round(_mean_trials(length, ttl) / device_rate, 2),
+        "mean_trials": int(_mean_trials(length, ttl)),
+    }
+
+
+def _bench_batch_queue() -> dict:
+    """Config 2: batched workerQueue — mixed-size objects in fused
+    multi-object launches (sampled: 64 of the 1k config, difficulty
+    /100 = reference test mode so the sample completes in seconds;
+    scheduling behavior, which is what this config exercises, is
+    difficulty-independent)."""
+    from pybitmessage_tpu.ops.sha512_pallas import solve_batch
+
+    ttl = 4 * 24 * 3600
+    sizes = [116, 1016, 10016, 216]       # mixed payloadLengthExtraBytes
+    items = []
+    for i in range(64):
+        length = sizes[i % len(sizes)]
+        ih = hashlib.sha512(b"bench queue %d" % i).digest()
+        items.append((ih, _default_target(length, ttl, ntpb=10, extra=10)))
+    solve_batch(items[:8])        # warm the batch-kernel compile
+    t0 = time.perf_counter()
+    results = solve_batch(items)
+    dt = time.perf_counter() - t0
+    total_trials = sum(r[1] for r in results)
+    return {
+        "objects": len(items), "sampled_from": 1000,
+        "difficulty": "defaults/100 (reference test mode)",
+        "wall_s": round(dt, 2),
+        "objects_per_s": round(len(items) / dt, 2),
+        "aggregate_hps": round(total_trials / dt, 1),
+    }
+
+
+def _bench_high_difficulty(device_rate: float, host_rate: float) -> dict:
+    """Config 3: nonceTrialsPerByte x64, TTL=28 d.  Mean work is
+    ~4.9e9 trials (~40 s/object on-chip) — reported as implied
+    wall-clock from the measured rates, the same methodology the
+    reference UI uses for its difficulty/10s estimate
+    (proofofwork.py:197-201)."""
+    ttl = 28 * 24 * 3600
+    length = 1016
+    trials = _mean_trials(length, ttl, ntpb=64 * 1000)
+    return {
+        "mean_trials": int(trials),
+        "implied_mean_s_per_object": round(trials / device_rate, 1),
+        "implied_cpu_hashlib_s": round(trials / host_rate, 0),
+    }
+
+
+def _bench_broadcast_storm() -> dict:
+    """Config 4: chan broadcast storm — many small objects (sampled:
+    256 of the 10k config at test-mode difficulty)."""
+    from pybitmessage_tpu.ops.sha512_pallas import solve_batch
+
+    ttl = 3600
+    items = []
+    for i in range(256):
+        ih = hashlib.sha512(b"bench storm %d" % i).digest()
+        items.append((ih, _default_target(116, ttl, ntpb=10, extra=10)))
+    solve_batch(items[:8])        # warm (shared compile w/ queue bench)
+    t0 = time.perf_counter()
+    results = solve_batch(items)
+    dt = time.perf_counter() - t0
+    return {
+        "objects": len(items), "sampled_from": 10000,
+        "difficulty": "defaults/100 (reference test mode)",
+        "wall_s": round(dt, 2),
+        "objects_per_s": round(len(items) / dt, 2),
+        "aggregate_hps": round(sum(r[1] for r in results) / dt, 1),
+    }
+
+
+def _bench_sharded_tier(initial_hash: bytes) -> dict:
+    """Config 5: the pod tier on a 1-device mesh (only one real chip
+    here) — per-chip rate of the production sharded path; multi-chip
+    partitioning itself is validated on the virtual CPU mesh
+    (tests/test_pow_pallas_sharded.py, dryrun_multichip)."""
+    from pybitmessage_tpu.ops.pow_search import PowInterrupted
+    from pybitmessage_tpu.ops.sha512_pallas import (DEFAULT_CHUNKS,
+                                                    DEFAULT_ROWS,
+                                                    DEFAULT_UNROLL,
+                                                    LANE_COLS)
+    from pybitmessage_tpu.parallel import make_mesh, pallas_sharded_solve
+
+    mesh = make_mesh(1)
+    # must match pallas_sharded_solve's own slab accounting (it runs
+    # DEFAULT_UNROLL tiles per grid step)
+    slab = DEFAULT_ROWS * LANE_COLS * DEFAULT_CHUNKS * DEFAULT_UNROLL
+    calls = {"n": 0}
+
+    def stop_after(n):
+        calls["n"] += 1
+        return calls["n"] > n
+
+    def run(budget: int, start: int) -> float:
+        calls["n"] = 0
+        t0 = time.perf_counter()
+        try:
+            pallas_sharded_solve(
+                initial_hash, 1, mesh, start_nonce=start,
+                should_stop=lambda: stop_after(budget))
+        except PowInterrupted:
+            pass
+        return budget * slab / (time.perf_counter() - t0)
+
+    run(1, 0)                                # compile + warm
+    rate = statistics.median(run(6, (i + 1) << 40) for i in range(3))
+    return {"per_chip_hps_1dev_mesh": round(rate, 1)}
+
+
 def main():
     initial_hash = hashlib.sha512(b"pybitmessage-tpu bench").digest()
     device, xla, kernel = _device_rate(initial_hash)
+    # only meaningful when the Pallas tier actually measured (on the
+    # XLA fallback path these must not masquerade as Pallas figures)
+    slab_rate = device if kernel == "pallas" else 0.0
+    effective = 0.0
+    if kernel == "pallas":
+        try:
+            effective = _device_rate_effective(initial_hash)
+        except Exception:
+            import traceback
+            traceback.print_exc(file=sys.stderr)
+        # headline = what a caller gets from the production solve();
+        # the synchronous slab rate stays reported alongside
+        device = max(device, effective)
     host = _host_rate(initial_hash)
     native = _native_rate(initial_hash)
+    configs = {}
+    if kernel == "pallas":          # config benches need the Mosaic tier
+        for name, fn in (
+                ("single_msg_default_difficulty",
+                 lambda: _bench_single_default(device)),
+                ("batched_queue_mixed", _bench_batch_queue),
+                ("high_difficulty_ntpb_x64_ttl28d",
+                 lambda: _bench_high_difficulty(device, host)),
+                ("broadcast_storm_small", _bench_broadcast_storm),
+                ("pod_sharded_tier",
+                 lambda: _bench_sharded_tier(initial_hash))):
+            try:
+                configs[name] = fn()
+            except Exception as exc:   # a config bench must not kill
+                configs[name] = {"error": repr(exc)[:200]}
+    # u32-op throughput / MFU (ops per trial counted from the jaxpr of
+    # the unrolled schedule the kernel executes — see BASELINE.md)
+    OPS_PER_TRIAL = 21152
     print(json.dumps({
         "metric": "double_sha512_trial_hashes_per_sec_per_chip",
         "value": round(device, 1),
         "unit": "H/s",
         "vs_baseline": round(device / host, 2),
         "kernel": kernel,
+        "u32_ops_per_sec": round(device * OPS_PER_TRIAL, 0),
         "baselines": {
             "python_hashlib_1core_hps": round(host, 1),
             "cpp_pthreads_allcores_hps": round(native, 1),
             "xla_windowed_hps": round(xla, 1),
+            "pallas_sync_slab_hps": round(slab_rate, 1),
+            "pallas_effective_solve_hps": round(effective, 1),
             "vs_cpp": round(device / native, 2) if native else None,
         },
+        "configs": configs,
     }))
 
 

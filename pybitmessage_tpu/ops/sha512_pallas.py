@@ -27,11 +27,17 @@ from .u64 import U32
 
 LANE_COLS = 128
 
-#: measured v5e sweet spot: 84.6 MH/s honest at (256 rows, 512 chunks)
-#: = 16.7M trials/slab (~200 ms).  rows=512 exceeds the 16 MB VMEM
-#: scoped limit; chunks=1024+ fails to compile.  See BASELINE.md.
-DEFAULT_ROWS = 256
+#: measured v5e sweet spot (r3 MFU experiment, BASELINE.md): FOUR
+#: independent 128-row tiles per grid step — the 160-round chains are
+#: dependency-limited, so extra instruction streams let the VPU
+#: dual/quad-issue.  Same-day same-chip ladder (rows=128, chunks=512):
+#: unroll=1: 77.8 MH/s, 2: 97.9, 3: 121.3, 4: 136.4, 6: 143.3 (compile
+#: 282 s — past the knee); 64-row streams lose (64x8: 133.5, 64x4:
+#: 90.2), two 256-row streams thrash VMEM (77.2), rows=512 exceeds the
+#: 16 MB scoped VMEM limit, chunks>=1024 fails to compile.
+DEFAULT_ROWS = 128
 DEFAULT_CHUNKS = 512
+DEFAULT_UNROLL = 4
 
 
 def _pair(value: int):
@@ -157,7 +163,7 @@ def _search_step(ih_pair, base_hi, base_lo, target_hi, target_lo,
 
 
 def _kernel(ih_ref, base_ref, target_ref, found_ref, nonce_ref, flag_ref, *,
-            rows: int):
+            rows: int, unroll: int = 1):
     step = pl.program_id(0)
 
     @pl.when(step == 0)
@@ -172,10 +178,23 @@ def _kernel(ih_ref, base_ref, target_ref, found_ref, nonce_ref, flag_ref, *,
 
     @pl.when(flag_ref[0] == 0)
     def do_search():
+        # ``unroll`` independent (rows, 128) tiles per grid step: the
+        # 160-round chains are dependency-limited, so interleaving 2+
+        # independent instruction streams lets the VPU dual-issue
+        # (MFU experiment, BASELINE.md "Arithmetic utilization")
         hit, n_hi, n_lo = _search_step(
             lambda i: (ih_ref[i, 0], ih_ref[i, 1]),
             base_ref[0], base_ref[1], target_ref[0], target_ref[1],
-            step, rows)
+            step * unroll, rows)
+        for u in range(1, unroll):
+            h2, nh2, nl2 = _search_step(
+                lambda i: (ih_ref[i, 0], ih_ref[i, 1]),
+                base_ref[0], base_ref[1], target_ref[0], target_ref[1],
+                step * unroll + u, rows)
+            # keep the FIRST sub-tile's winner (lowest nonce range)
+            n_hi = jnp.where(hit == 1, n_hi, nh2)
+            n_lo = jnp.where(hit == 1, n_lo, nl2)
+            hit = jnp.maximum(hit, h2)
         found_ref[step, 0] = hit
         flag_ref[0] = hit
         nonce_ref[step, 0] = n_hi
@@ -319,17 +338,21 @@ def solve_batch(items, *, rows: int = DEFAULT_ROWS,
     return results
 
 
-@functools.partial(jax.jit, static_argnames=("rows", "chunks", "interpret"))
+@functools.partial(jax.jit, static_argnames=("rows", "chunks", "interpret",
+                                             "unroll"))
 def pallas_search(ih_words, base, target, rows: int = 256,
-                  chunks: int = 16, interpret: bool = False):
-    """Search nonces [base, base + chunks*rows*128) for value <= target.
+                  chunks: int = 16, interpret: bool = False,
+                  unroll: int = 1):
+    """Search nonces [base, base + chunks*unroll*rows*128) for value
+    <= target.
 
     ``ih_words``: (8, 2) uint32 — initial-hash words as (hi, lo);
     ``base``/``target``: (2,) uint32 pairs.  Returns (found (chunks,),
-    nonce (chunks, 2)) per grid step.
+    nonce (chunks, 2)) per grid step; each grid step covers ``unroll``
+    consecutive (rows, 128) tiles.
     """
     grid = (chunks,)
-    kernel = functools.partial(_kernel, rows=rows)
+    kernel = functools.partial(_kernel, rows=rows, unroll=unroll)
     found, nonce = pl.pallas_call(
         kernel,
         out_shape=(jax.ShapeDtypeStruct((chunks, 1), jnp.int32),
@@ -354,18 +377,20 @@ def pallas_search(ih_words, base, target, rows: int = 256,
 
 def solve(initial_hash: bytes, target: int, *,
           start_nonce: int = 0, rows: int = DEFAULT_ROWS,
-          chunks_per_call: int = DEFAULT_CHUNKS, should_stop=None,
+          chunks_per_call: int = DEFAULT_CHUNKS,
+          unroll: int = DEFAULT_UNROLL, should_stop=None,
           interpret: bool = False):
     """Find a nonce whose trial value is <= target (Pallas backend).
 
     Same contract as :func:`pow_search.solve`: returns
     ``(nonce, trials_done)`` or raises ``PowInterrupted``.  The host
-    re-invokes the kernel in slabs of ``chunks_per_call * rows * 128``
-    trials so the shutdown callback stays responsive (reference host
-    loop: src/openclpow.py:96-107), and keeps one slab in flight ahead
-    of the one being harvested — measured 86-97 MH/s effective on a
-    v5e chip vs 84.6 MH/s for the synchronous slab loop (the dispatch
-    and host-transfer gaps hide behind device compute).  Trials are
+    re-invokes the kernel in slabs of ``chunks_per_call * rows * 128 *
+    unroll`` trials so the shutdown callback stays responsive
+    (reference host loop: src/openclpow.py:96-107), and keeps one slab
+    in flight ahead of the one being harvested so dispatch and
+    host-transfer gaps hide behind device compute.  The r3 production
+    slab (128 x 512 x 4) measures 136.4 MH/s — see BASELINE.md
+    "Arithmetic utilization" for the unroll ladder.  Trials are
     accounted at slab granularity.
     """
     import numpy as np
@@ -380,14 +405,15 @@ def solve(initial_hash: bytes, target: int, *,
     target &= (1 << 64) - 1
     target_arr = jnp.array([target >> 32, target & 0xFFFFFFFF], dtype=U32)
 
-    trials_per_slab = rows * LANE_COLS * chunks_per_call
+    trials_per_slab = rows * LANE_COLS * chunks_per_call * unroll
     mask64 = (1 << 64) - 1
 
     def launch(base_int: int):
         base = jnp.array([(base_int >> 32) & 0xFFFFFFFF,
                           base_int & 0xFFFFFFFF], dtype=jnp.uint32)
         return pallas_search(ih_words, base, target_arr, rows=rows,
-                             chunks=chunks_per_call, interpret=interpret)
+                             chunks=chunks_per_call, unroll=unroll,
+                             interpret=interpret)
 
     def harvest(found_dev, nonce_dev):
         """Sync one slab's results; returns the winning nonce or None."""

@@ -39,11 +39,24 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from ..ops.sha512_jax import DEFAULT_VARIANT, trial_values
 from ..ops.sha512_pallas import (LANE_COLS, DEFAULT_CHUNKS, DEFAULT_ROWS,
-                                 pallas_batch_search, pallas_search)
+                                 DEFAULT_UNROLL, pallas_batch_search,
+                                 pallas_search)
 from ..ops.u64 import U32, add64, le64, mul_u32_const
 from ..ops.pow_search import PowInterrupted
 
 _MASK64 = (1 << 64) - 1
+
+#: chunks >= 1024 fails to compile (BASELINE.md kernel-bounds table)
+_MAX_BATCH_CHUNKS = 512
+
+
+def _batch_chunks(chunks: int, unroll: int) -> int:
+    """Effective grid-chunk count for the batch kernel: it runs
+    unroll=1 (its grid already interleaves objects), so the per-call
+    trial budget is carried by more chunks, clamped at the compile
+    bound.  Single source of truth for _get_fn and the host loop's
+    slab/stride accounting."""
+    return min(chunks * unroll, _MAX_BATCH_CHUNKS)
 
 
 def default_impl() -> str:
@@ -101,6 +114,7 @@ def _resolve_winner(hit, n_hi, n_lo, axis: str):
 
 def make_pallas_sharded_search(mesh: Mesh, *, rows: int = DEFAULT_ROWS,
                                chunks: int = DEFAULT_CHUNKS,
+                               unroll: int = DEFAULT_UNROLL,
                                axis: str | None = None,
                                impl: str = "pallas",
                                interpret: bool = False,
@@ -113,7 +127,7 @@ def make_pallas_sharded_search(mesh: Mesh, *, rows: int = DEFAULT_ROWS,
     """
     if axis is None:
         axis = mesh.axis_names[-1]
-    slab = rows * LANE_COLS * chunks
+    slab = rows * LANE_COLS * chunks * unroll
 
     def body(ih_words, base, target):
         dev = jax.lax.axis_index(axis).astype(U32)
@@ -122,10 +136,11 @@ def make_pallas_sharded_search(mesh: Mesh, *, rows: int = DEFAULT_ROWS,
         if impl == "pallas":
             found, nonce = pallas_search(ih_words, local_base, target,
                                          rows=rows, chunks=chunks,
+                                         unroll=unroll,
                                          interpret=interpret)
         else:
             found, nonce = _xla_slab(ih_words, local_base, target,
-                                     rows=rows, chunks=chunks,
+                                     rows=rows, chunks=chunks * unroll,
                                      variant=variant)
         return _resolve_winner(*_first_hit(found, nonce), axis)
 
@@ -199,14 +214,18 @@ def make_pallas_sharded_batch_search(mesh: Mesh, *,
 _FN_CACHE: dict = {}
 
 
-def _get_fn(mesh: Mesh, kind: str, rows: int, chunks: int, impl: str,
-            interpret: bool, variant: str):
-    key = (mesh, kind, rows, chunks, impl, interpret, variant)
+def _get_fn(mesh: Mesh, kind: str, rows: int, chunks: int, unroll: int,
+            impl: str, interpret: bool, variant: str):
+    key = (mesh, kind, rows, chunks, unroll, impl, interpret, variant)
     if key not in _FN_CACHE:
-        make = (make_pallas_sharded_search if kind == "single"
-                else make_pallas_sharded_batch_search)
-        _FN_CACHE[key] = make(mesh, rows=rows, chunks=chunks, impl=impl,
-                              interpret=interpret, variant=variant)
+        if kind == "single":
+            _FN_CACHE[key] = make_pallas_sharded_search(
+                mesh, rows=rows, chunks=chunks, unroll=unroll, impl=impl,
+                interpret=interpret, variant=variant)
+        else:
+            _FN_CACHE[key] = make_pallas_sharded_batch_search(
+                mesh, rows=rows, chunks=_batch_chunks(chunks, unroll),
+                impl=impl, interpret=interpret, variant=variant)
     return _FN_CACHE[key]
 
 
@@ -224,6 +243,7 @@ def _pair_arr(value: int):
 def pallas_sharded_solve(initial_hash: bytes, target: int, mesh: Mesh, *,
                          start_nonce: int = 0, rows: int = DEFAULT_ROWS,
                          chunks_per_call: int = DEFAULT_CHUNKS,
+                         unroll: int = DEFAULT_UNROLL,
                          impl: str | None = None, interpret: bool = False,
                          variant: str = DEFAULT_VARIANT,
                          should_stop: Callable[[], bool] | None = None):
@@ -243,12 +263,12 @@ def pallas_sharded_solve(initial_hash: bytes, target: int, mesh: Mesh, *,
     ndev = mesh.devices.size
     nonce_devs = mesh.shape[mesh.axis_names[-1]] if len(mesh.axis_names) > 1 \
         else ndev
-    fn = _get_fn(mesh, "single", rows, chunks_per_call, impl, interpret,
-                 variant)
+    fn = _get_fn(mesh, "single", rows, chunks_per_call, unroll, impl,
+                 interpret, variant)
     ih_words = _ih_words_arr(initial_hash)
     target &= _MASK64
     target_arr = _pair_arr(target)
-    slab = rows * LANE_COLS * chunks_per_call
+    slab = rows * LANE_COLS * chunks_per_call * unroll
     stride = nonce_devs * slab
 
     def harvest(out):
@@ -292,6 +312,7 @@ _ALWAYS_HIT = _MASK64
 def pallas_sharded_solve_batch(items, mesh: Mesh, *,
                                rows: int = DEFAULT_ROWS,
                                chunks_per_call: int = DEFAULT_CHUNKS,
+                               unroll: int = DEFAULT_UNROLL,
                                impl: str | None = None,
                                interpret: bool = False,
                                variant: str = DEFAULT_VARIANT,
@@ -317,8 +338,8 @@ def pallas_sharded_solve_batch(items, mesh: Mesh, *,
     if len(mesh.axis_names) < 2:
         return [pallas_sharded_solve(ih, t, mesh, rows=rows,
                                      chunks_per_call=chunks_per_call,
-                                     impl=impl, interpret=interpret,
-                                     variant=variant,
+                                     unroll=unroll, impl=impl,
+                                     interpret=interpret, variant=variant,
                                      should_stop=should_stop)
                 for ih, t in items]
 
@@ -329,11 +350,11 @@ def pallas_sharded_solve_batch(items, mesh: Mesh, *,
     ihs = [ih for ih, _ in items] + [b"\x00" * 64] * pad
     targets = [t & _MASK64 for _, t in items] + [_ALWAYS_HIT] * pad
 
-    fn = _get_fn(mesh, "batch", rows, chunks_per_call, impl, interpret,
-                 variant)
+    fn = _get_fn(mesh, "batch", rows, chunks_per_call, unroll, impl,
+                 interpret, variant)
     ih_words = jnp.stack([_ih_words_arr(ih) for ih in ihs])
     t_arr = jnp.stack([_pair_arr(t) for t in targets])
-    slab = rows * LANE_COLS * chunks_per_call
+    slab = rows * LANE_COLS * _batch_chunks(chunks_per_call, unroll)
     stride = nonce_devs * slab
 
     bases = [0] * total

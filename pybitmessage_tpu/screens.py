@@ -58,15 +58,18 @@ def bind(vm: ViewModel, path: Path | None = None) -> dict[str, Screen]:
     each named method exists — a broken registry fails at startup, not
     when the user taps the screen."""
 
-    def resolve(spec: dict, key: str, screen: str):
-        name = spec.get(key)
-        if name is None:
+    def resolve(target: str | None, what: str, screen: str,
+                required: bool = False):
+        if target is None:
+            if required:
+                raise ScreenError("screen %r %s binding missing"
+                                  % (screen, what))
             return None
-        fn = getattr(vm, name, None)
+        fn = getattr(vm, target, None)
         if not callable(fn):
             raise ScreenError(
                 "screen %r binds %s=%r which ViewModel lacks"
-                % (screen, key, name))
+                % (screen, what, target))
         return fn
 
     screens: dict[str, Screen] = {}
@@ -75,29 +78,18 @@ def bind(vm: ViewModel, path: Path | None = None) -> dict[str, Screen]:
         if kind not in ("list", "status", "form"):
             raise ScreenError("screen %r has unknown kind %r"
                               % (name, kind))
-        actions = {}
-        for act, target in spec.get("actions", {}).items():
-            fn = getattr(vm, target, None)
-            if not callable(fn):
-                raise ScreenError(
-                    "screen %r action %r binds %r which ViewModel lacks"
-                    % (name, act, target))
-            actions[act] = fn
+        actions = {
+            act: resolve(target, "action %r" % act, name, required=True)
+            for act, target in spec.get("actions", {}).items()}
         form = spec.get("form", {})
-        submit = None
-        if form:
-            submit = getattr(vm, form.get("submit", ""), None)
-            if not callable(submit):
-                raise ScreenError(
-                    "screen %r form submit %r missing on ViewModel"
-                    % (name, form.get("submit")))
         screens[name] = Screen(
             name=name, title=spec.get("title", name), kind=kind,
-            render=resolve(spec, "render", name),
-            detail=resolve(spec, "detail", name),
+            render=resolve(spec.get("render"), "render", name),
+            detail=resolve(spec.get("detail"), "detail", name),
             actions=actions,
             form_fields=tuple(form.get("fields", ())),
-            submit=submit)
+            submit=resolve(form.get("submit"), "form submit", name,
+                           required=bool(form)))
     return screens
 
 

@@ -115,9 +115,12 @@ def run(rpc: RPCClient) -> int:  # pragma: no cover - needs a tty
                 except CommandError as exc:
                     status_line = f"error: {exc}"
             elif key == ord("a"):
-                label = prompt(stdscr, "Label: ")
-                vm.create_address(label)
-                vm.refresh()
+                try:
+                    label = prompt(stdscr, "Label: ")
+                    vm.create_address(label)
+                    vm.refresh()
+                except CommandError as exc:
+                    status_line = f"error: {exc}"
             elif key == ord("+") and pane in ("Addressbook", "Blacklist"):
                 try:
                     address = prompt(stdscr, "Address: ")

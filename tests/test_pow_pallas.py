@@ -26,7 +26,7 @@ def test_pallas_solve_finds_valid_nonce():
 
     ih = hashlib.sha512(b"pallas tpu test").digest()
     target = 2 ** 55
-    nonce, trials = solve(ih, target, rows=256, chunks_per_call=32)
+    nonce, trials = solve(ih, target, chunks_per_call=32)
     check = double_sha512(nonce.to_bytes(8, "big") + ih)
     assert int.from_bytes(check[:8], "big") <= target
     assert trials > 0
@@ -71,7 +71,7 @@ def test_pallas_sharded_1dev_mesh_matches_direct():
 
     ih = hashlib.sha512(b"sharded == direct").digest()
     target = 2 ** 40          # unreachable-ish: forces multiple slabs
-    rows, chunks = 256, 128
+    rows, chunks = 128, 128   # production row width (x unroll default)
 
     def timed(fn):
         t0 = time.monotonic()
@@ -139,7 +139,7 @@ def test_pallas_sharded_solve_on_chip_finds_nonce():
     ih = hashlib.sha512(b"sharded pallas on chip").digest()
     target = 2 ** 55
     mesh = make_mesh(1)
-    nonce, trials = pallas_sharded_solve(ih, target, mesh, rows=256,
+    nonce, trials = pallas_sharded_solve(ih, target, mesh,
                                          chunks_per_call=32)
     check = double_sha512(nonce.to_bytes(8, "big") + ih)
     assert int.from_bytes(check[:8], "big") <= target
