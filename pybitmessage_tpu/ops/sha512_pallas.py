@@ -162,6 +162,26 @@ def _search_step(ih_pair, base_hi, base_lo, target_hi, target_lo,
     return hit, base_hi + wc, wl
 
 
+def _unrolled_search(ih_pair, base_hi, base_lo, t_hi, t_lo, step,
+                     rows: int, unroll: int):
+    """``unroll`` independent (rows, 128) tiles for one grid step.
+
+    The 160-round chains are dependency-limited, so interleaving
+    independent instruction streams lets the VPU multi-issue (the MFU
+    lever, BASELINE.md "Arithmetic utilization").  Keeps the FIRST
+    sub-tile's winner (lowest nonce range).  Shared by the single and
+    batch kernels."""
+    hit, n_hi, n_lo = _search_step(ih_pair, base_hi, base_lo, t_hi, t_lo,
+                                   step * unroll, rows)
+    for u in range(1, unroll):
+        h2, nh2, nl2 = _search_step(ih_pair, base_hi, base_lo, t_hi, t_lo,
+                                    step * unroll + u, rows)
+        n_hi = jnp.where(hit == 1, n_hi, nh2)
+        n_lo = jnp.where(hit == 1, n_lo, nl2)
+        hit = jnp.maximum(hit, h2)
+    return hit, n_hi, n_lo
+
+
 def _kernel(ih_ref, base_ref, target_ref, found_ref, nonce_ref, flag_ref, *,
             rows: int, unroll: int = 1):
     step = pl.program_id(0)
@@ -178,23 +198,10 @@ def _kernel(ih_ref, base_ref, target_ref, found_ref, nonce_ref, flag_ref, *,
 
     @pl.when(flag_ref[0] == 0)
     def do_search():
-        # ``unroll`` independent (rows, 128) tiles per grid step: the
-        # 160-round chains are dependency-limited, so interleaving 2+
-        # independent instruction streams lets the VPU dual-issue
-        # (MFU experiment, BASELINE.md "Arithmetic utilization")
-        hit, n_hi, n_lo = _search_step(
+        hit, n_hi, n_lo = _unrolled_search(
             lambda i: (ih_ref[i, 0], ih_ref[i, 1]),
             base_ref[0], base_ref[1], target_ref[0], target_ref[1],
-            step * unroll, rows)
-        for u in range(1, unroll):
-            h2, nh2, nl2 = _search_step(
-                lambda i: (ih_ref[i, 0], ih_ref[i, 1]),
-                base_ref[0], base_ref[1], target_ref[0], target_ref[1],
-                step * unroll + u, rows)
-            # keep the FIRST sub-tile's winner (lowest nonce range)
-            n_hi = jnp.where(hit == 1, n_hi, nh2)
-            n_lo = jnp.where(hit == 1, n_lo, nl2)
-            hit = jnp.maximum(hit, h2)
+            step, rows, unroll)
         found_ref[step, 0] = hit
         flag_ref[0] = hit
         nonce_ref[step, 0] = n_hi
@@ -202,12 +209,14 @@ def _kernel(ih_ref, base_ref, target_ref, found_ref, nonce_ref, flag_ref, *,
 
 
 def _batch_kernel(ih_ref, base_ref, target_ref, found_ref, nonce_ref,
-                  flag_ref, *, rows: int):
+                  flag_ref, *, rows: int, unroll: int = 1):
     """2D grid (objects, chunks): each object owns a per-object early-
     exit flag, so easy objects stop costing compute while hard ones
     keep searching — the single-chip form of the (objects x
     nonce-lanes) batch design (SURVEY §6).  The search body is shared
-    with the single-object kernel (_search_step)."""
+    with the single-object kernel (_search_step), including its
+    ``unroll`` independent instruction streams per grid step (the ILP
+    lever that lifted the single kernel 1.75x — BASELINE.md)."""
     obj = pl.program_id(0)
     step = pl.program_id(1)
 
@@ -221,26 +230,29 @@ def _batch_kernel(ih_ref, base_ref, target_ref, found_ref, nonce_ref,
 
     @pl.when(flag_ref[obj] == 0)
     def do_search():
-        hit, n_hi, n_lo = _search_step(
+        hit, n_hi, n_lo = _unrolled_search(
             lambda i: (ih_ref[obj, i, 0], ih_ref[obj, i, 1]),
             base_ref[obj, 0], base_ref[obj, 1],
-            target_ref[obj, 0], target_ref[obj, 1], step, rows)
+            target_ref[obj, 0], target_ref[obj, 1], step, rows, unroll)
         found_ref[obj, step] = hit
         flag_ref[obj] = hit
         nonce_ref[obj, step, 0] = n_hi
         nonce_ref[obj, step, 1] = n_lo
 
 
-@functools.partial(jax.jit, static_argnames=("rows", "chunks", "interpret"))
+@functools.partial(jax.jit, static_argnames=("rows", "chunks", "interpret",
+                                             "unroll"))
 def pallas_batch_search(ih_words, bases, targets, rows: int = 256,
-                        chunks: int = 128, interpret: bool = False):
+                        chunks: int = 128, interpret: bool = False,
+                        unroll: int = 1):
     """Search B objects' nonce ranges in ONE kernel launch.
 
     ``ih_words``: (B, 8, 2) uint32; ``bases``/``targets``: (B, 2).
-    Returns (found (B, chunks) int32, nonce (B, chunks, 2) uint32).
+    Returns (found (B, chunks) int32, nonce (B, chunks, 2) uint32);
+    each grid step covers ``unroll`` consecutive (rows, 128) tiles.
     """
     n_obj = ih_words.shape[0]
-    kernel = functools.partial(_batch_kernel, rows=rows)
+    kernel = functools.partial(_batch_kernel, rows=rows, unroll=unroll)
     found, nonce = pl.pallas_call(
         kernel,
         out_shape=(jax.ShapeDtypeStruct((n_obj, chunks), jnp.int32),
@@ -263,13 +275,17 @@ def pallas_batch_search(ih_words, bases, targets, rows: int = 256,
 
 #: pad batches to this many objects per launch — one compiled program
 #: serves any batch size; always-hit targets make pad slots skip after
-#: their first chunk via the per-object flag
-BATCH_OBJS = 8
-BATCH_CHUNKS = 128
+#: their first chunk via the per-object flag.  r3: 16 objects/launch
+#: with the same ILP unroll as the single kernel (32 objects at these
+#: chunk counts exceeds the 1 MB SMEM budget: 1.17M used).
+BATCH_OBJS = 16
+BATCH_CHUNKS = 64
+BATCH_UNROLL = DEFAULT_UNROLL
 
 
 def solve_batch(items, *, rows: int = DEFAULT_ROWS,
-                chunks_per_call: int = BATCH_CHUNKS, should_stop=None,
+                chunks_per_call: int = BATCH_CHUNKS,
+                unroll: int = BATCH_UNROLL, should_stop=None,
                 interpret: bool = False):
     """Solve ``[(initial_hash, target), ...]`` in batched launches.
 
@@ -288,7 +304,7 @@ def solve_batch(items, *, rows: int = DEFAULT_ROWS,
         return []
     results: list = [None] * n
     mask64 = (1 << 64) - 1
-    trials_per_slab = rows * LANE_COLS * chunks_per_call
+    trials_per_slab = rows * LANE_COLS * chunks_per_call * unroll
 
     for group_start in range(0, n, BATCH_OBJS):
         group = list(range(group_start, min(group_start + BATCH_OBJS, n)))
@@ -313,7 +329,8 @@ def solve_batch(items, *, rows: int = DEFAULT_ROWS,
                 dtype=U32)
             found, nonce = pallas_batch_search(
                 ih_words, b_arr, t_arr, rows=rows,
-                chunks=chunks_per_call, interpret=interpret)
+                chunks=chunks_per_call, unroll=unroll,
+                interpret=interpret)
             f = np.asarray(found)
             nn = np.asarray(nonce)
             for k in range(BATCH_OBJS):

@@ -51,11 +51,15 @@ _MAX_BATCH_CHUNKS = 512
 
 
 def _batch_chunks(chunks: int, unroll: int) -> int:
-    """Effective grid-chunk count for the batch kernel: it runs
-    unroll=1 (its grid already interleaves objects), so the per-call
-    trial budget is carried by more chunks, clamped at the compile
-    bound.  Single source of truth for _get_fn and the host loop's
-    slab/stride accounting."""
+    """Effective grid-chunk count for the pod batch kernel, which runs
+    unroll=1: per-device object counts are unbounded here (B/obj_size),
+    and the unrolled batch body blows the 1 MB SMEM budget beyond ~16
+    objects x 64 chunks (BASELINE.md) — so the per-call trial budget is
+    carried by more chunks instead, clamped at the compile bound.
+    Single source of truth for _get_fn and the host loop's slab/stride
+    accounting.  (The single-chip ``solve_batch`` groups objects <= 16
+    per launch and does use the unroll — a grouping pass here would
+    unlock the same ~38% for the pod tier; future work.)"""
     return min(chunks * unroll, _MAX_BATCH_CHUNKS)
 
 
