@@ -142,13 +142,23 @@ def _h_sent(rpc, argv):
 
 
 def _h_read(rpc, argv):
+    from .utils.safetext import extract_links, sanitize, sanitize_line
     out = json.loads(rpc.call("getInboxMessageById", argv[0], True))
     for m in out["inboxMessage"]:
+        raw = _unb64(m["message"])
         print(f"From:    {m['fromAddress']}")
         print(f"To:      {m['toAddress']}")
-        print(f"Subject: {_unb64(m['subject'])}")
+        print(f"Subject: {sanitize_line(_unb64(m['subject']))}")
         print()
-        print(_unb64(m["message"]))
+        # untrusted body: markup/escape-sequence stripped, link targets
+        # listed visibly (utils/safetext.py, safehtmlparser role)
+        print(sanitize(raw))
+        links = extract_links(raw)
+        if links:
+            print()
+            print("Links:")
+            for link in links:
+                print("  " + link)
 
 
 def _h_status(rpc, argv):

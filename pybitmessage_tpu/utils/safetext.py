@@ -1,0 +1,123 @@
+"""Safe rendering of untrusted message bodies.
+
+Role model: the reference's MessageView renders messages through
+``SafeHTMLParser`` (bitmessageqt/safehtmlparser.py) because Qt rich-text
+widgets would otherwise interpret attacker-controlled HTML — it keeps an
+element allowlist, strips active content and remote resources, and
+linkifies URIs.  Our frontends are plain-text surfaces (curses, tkinter
+Text, terminal), so the safe design inverts: NOTHING is ever rendered
+as markup.  This module reduces an HTML-ish body to readable plain text
+(scripts/styles dropped wholesale, entities decoded, block structure
+mapped to newlines) and surfaces any URIs separately so a user can see
+exactly where a link would take them before copying it — links are
+never made clickable-with-hidden-target, which is where HTML mail
+phishing lives.
+"""
+
+from __future__ import annotations
+
+import re
+from html.parser import HTMLParser
+
+#: tags whose CONTENT is dangerous noise, not prose
+_DROP_CONTENT = {"script", "style", "head", "title", "template"}
+
+#: block-level tags mapped to line breaks for readability
+_BLOCK = {"p", "div", "br", "tr", "li", "h1", "h2", "h3", "h4", "h5",
+          "h6", "blockquote", "pre", "table", "ul", "ol", "hr"}
+
+_TAG_RE = re.compile(r"</?[a-zA-Z][^>]*>")
+
+#: only treat a body as HTML when it contains a tag NAME we know —
+#: plain-text conventions like <alice@example.com> or <https://url>
+#: must never be eaten by the markup stripper
+_KNOWN_TAG_RE = re.compile(
+    r"</?(?:p|div|br|span|a|b|i|u|s|em|strong|html|body|head|img|font|"
+    r"center|hr|tt|code|pre|blockquote|ul|ol|li|table|tr|td|th|h[1-6]|"
+    r"script|style|title|template)\b[^>]*>", re.IGNORECASE)
+
+#: conservative URI extraction (http/https/ftp + the bitcoin: scheme the
+#: reference linkifies, bitmessageqt/safehtmlparser.py uriregex)
+_URI_RE = re.compile(
+    r"\b(?:https?|ftp)://[^\s<>\"')\]}]+|\bbitcoin:[0-9a-zA-Z?=&.\-_]+")
+
+
+def looks_like_html(body: str) -> bool:
+    """Heuristic the reference's MessageView uses to pick its renderer:
+    presence of real markup (a known tag name), not just angle-bracket
+    conventions like ``<user@example.com>``."""
+    return bool(_KNOWN_TAG_RE.search(body))
+
+
+class _TextExtractor(HTMLParser):
+    def __init__(self):
+        super().__init__(convert_charrefs=True)
+        self.parts: list[str] = []
+        self._suppress = 0
+
+    def handle_starttag(self, tag, attrs):
+        if tag in _DROP_CONTENT:
+            self._suppress += 1
+        elif tag in _BLOCK:
+            self.parts.append("\n")
+        # an <a href=...> target is information the user must SEE:
+        # surface it inline instead of hiding it behind the anchor text
+        if tag == "a":
+            for name, value in attrs:
+                if name == "href" and value and not value.startswith("#"):
+                    self.parts.append(" <%s> " % value)
+
+    def handle_endtag(self, tag):
+        if tag in _DROP_CONTENT and self._suppress:
+            self._suppress -= 1
+        elif tag in _BLOCK:
+            self.parts.append("\n")
+
+    def handle_data(self, data):
+        if not self._suppress:
+            self.parts.append(data)
+
+
+def sanitize(body: str) -> str:
+    """Untrusted body -> displayable plain text.
+
+    Plain bodies pass through unchanged; HTML-ish bodies are reduced to
+    their text (active content dropped, entities decoded, block tags as
+    newlines, anchor targets made visible).  Control characters that
+    could corrupt a terminal (curses TUI) are stripped either way.
+    """
+    if looks_like_html(body):
+        extractor = _TextExtractor()
+        try:
+            extractor.feed(body)
+            extractor.close()
+            body = "".join(extractor.parts)
+        except Exception:              # malformed markup: show raw text
+            body = _TAG_RE.sub(" ", body)
+        body = re.sub(r"\n{3,}", "\n\n", body).strip("\n")
+        body = re.sub(r"[ \t]{2,}", " ", body)
+    # terminal-hostile controls: C0 (ESC sequences rewrite the screen),
+    # DEL, and C1 (U+0080-U+009F — a bare 0x9B is an 8-bit CSI on
+    # terminals that honor C1)
+    return "".join(ch for ch in body
+                   if ch in "\n\t"
+                   or (ch >= " " and ch != "\x7f"
+                       and not "\x80" <= ch <= "\x9f"))
+
+
+def sanitize_line(text: str) -> str:
+    """Single-line variant for headers and list columns: markup and
+    controls stripped AND line structure collapsed, so an attacker-
+    controlled subject can't inject spoofed header lines into the
+    message view or escape its list row."""
+    return " ".join(sanitize(text).split()) or ""
+
+
+def extract_links(body: str) -> list[str]:
+    """URIs found in the (raw) body, deduplicated in order — shown to
+    the user as a separate list, never auto-followed or fetched."""
+    seen = []
+    for match in _URI_RE.findall(body):
+        if match not in seen:
+            seen.append(match)
+    return seen

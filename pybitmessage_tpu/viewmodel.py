@@ -16,6 +16,7 @@ import json
 from .cli import CommandError, RPCClient, _b64, _unb64
 from .core.i18n import tr
 from .utils.identicon import derive, render_compact
+from .utils.safetext import extract_links, sanitize, sanitize_line
 
 PANES = ("Inbox", "Sent", "Identities", "Subscriptions", "Addressbook",
          "Blacklist", "Network")
@@ -81,7 +82,7 @@ class ViewModel:
             return ["(" + tr("inbox empty") + ")"]
         return [_clip(
             f"{'  ' if m.get('read') else '* '}"
-            f"{_unb64(m['subject']):30.30s}  "
+            f"{sanitize_line(_unb64(m['subject'])):30.30s}  "
             f"{m['fromAddress']:40.40s} -> {m['toAddress']}", width)
             for m in self.inbox]
 
@@ -89,7 +90,8 @@ class ViewModel:
         if not self.sent:
             return ["(" + tr("nothing sent") + ")"]
         return [_clip(
-            f"{m['status']:22.22s} {_unb64(m['subject']):30.30s} "
+            f"{m['status']:22.22s} "
+            f"{sanitize_line(_unb64(m['subject'])):30.30s} "
             f"-> {m['toAddress']}", width) for m in self.sent]
 
     def render_addresses(self, width: int) -> list[str]:
@@ -155,12 +157,17 @@ class ViewModel:
             self.rpc.call("getInboxMessageById", m["msgid"], True)
         except CommandError:
             pass
-        body = _unb64(m["message"])
+        raw = _unb64(m["message"])
+        # untrusted body: strip markup/active content, keep links
+        # visible (reference renders through SafeHTMLParser;
+        # utils/safetext.py is the plain-text-surface analog)
+        body = sanitize(raw)
         icon = render_compact(derive(m["fromAddress"])).splitlines()
         lines = [
             f"{icon[0]}  {tr('From')}:    {m['fromAddress']}",
             f"{icon[1]}  {tr('To')}:      {m['toAddress']}",
-            f"{icon[2]}  {tr('Subject')}: {_unb64(m['subject'])}",
+            f"{icon[2]}  {tr('Subject')}: "
+            f"{sanitize_line(_unb64(m['subject']))}",
             f"{icon[3]}",
         ]
         for para in body.splitlines() or [""]:
@@ -168,6 +175,17 @@ class ViewModel:
                 lines.append(para[:width - 1])
                 para = para[width - 1:]
             lines.append(para)
+        links = extract_links(raw)
+        if links:
+            lines.append("")
+            lines.append(tr("Links") + ":")
+            # wrap, don't clip: the whole target must be inspectable
+            for link in links:
+                line = "  " + link
+                while len(line) >= width:
+                    lines.append(line[:width - 1])
+                    line = "   " + line[width - 1:]
+                lines.append(line)
         return [_clip(ln, width) for ln in lines]
 
     # -- actions -------------------------------------------------------------

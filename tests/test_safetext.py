@@ -1,0 +1,127 @@
+"""Safe message rendering (utils/safetext.py — the reference
+bitmessageqt/safehtmlparser.py role, redesigned for plain-text
+surfaces: never render markup, make link targets visible)."""
+
+from pybitmessage_tpu.utils.safetext import (
+    extract_links, looks_like_html, sanitize, sanitize_line,
+)
+
+
+def test_plain_text_passes_through():
+    assert sanitize("hello\nworld") == "hello\nworld"
+    assert not looks_like_html("a < b and c > d")
+
+
+def test_html_reduced_to_text():
+    out = sanitize("<p>Hello <b>bold</b> world</p><p>second</p>")
+    assert "Hello bold world" in out
+    assert "second" in out
+    assert "<" not in out
+
+
+def test_script_and_style_content_dropped():
+    out = sanitize("<p>keep</p><script>alert('pwn')</script>"
+                   "<style>body{}</style><p>also keep</p>")
+    assert "keep" in out and "also keep" in out
+    assert "alert" not in out and "body{}" not in out
+
+
+def test_anchor_targets_made_visible():
+    out = sanitize('<a href="http://evil.example/x">Click for prize</a>')
+    assert "Click for prize" in out
+    assert "http://evil.example/x" in out, \
+        "the real target must be visible next to the anchor text"
+
+
+def test_entities_decoded():
+    assert "a < b & c" in sanitize("<p>a &lt; b &amp; c</p>")
+
+
+def test_terminal_escape_sequences_stripped():
+    # ESC sequences could rewrite a curses screen or retitle a terminal
+    out = sanitize("safe\x1b]0;pwned\x07text\x1b[2J")
+    assert "\x1b" not in out and "\x07" not in out
+    assert "safe" in out and "text" in out
+
+
+def test_malformed_html_never_raises():
+    out = sanitize("<p unclosed <b>text</ <<<>")
+    assert "text" in out
+
+
+def test_extract_links_ordered_dedup():
+    body = ("see https://example.org/a and http://two.example then "
+            "https://example.org/a again plus bitcoin:1BoatSLRHtKNngkdXEeobR76b53LETtpyT")
+    assert extract_links(body) == [
+        "https://example.org/a",
+        "http://two.example",
+        "bitcoin:1BoatSLRHtKNngkdXEeobR76b53LETtpyT",
+    ]
+
+
+def test_angle_bracket_conventions_preserved():
+    """<user@host> and <https://url> are prose, not markup — they must
+    survive sanitization verbatim (r3 review finding)."""
+    body = "Reply to <alice@example.com> or see <https://example.org/x>"
+    assert sanitize(body) == body
+    assert not looks_like_html(body)
+
+
+def test_c1_controls_stripped():
+    # a bare 0x9B is an 8-bit CSI on terminals honoring C1 controls
+    out = sanitize("safe\x9b2Jtext\x85")
+    assert "\x9b" not in out and "\x85" not in out
+    assert "safe" in out and "text" in out
+
+
+def test_sanitize_line_collapses_structure():
+    """A subject must never inject extra header lines into the reader
+    (spoofed From: line attack, r3 review finding)."""
+    spoof = "urgent<br>From:    BM-trustedAddress"
+    out = sanitize_line(spoof)
+    assert "\n" not in out
+    assert out == "urgent From: BM-trustedAddress"
+
+
+def test_viewmodel_panes_render_hostile_subject_safely():
+    from pybitmessage_tpu.viewmodel import ViewModel
+    import base64
+
+    vm = ViewModel.__new__(ViewModel)
+    evil = base64.b64encode(
+        "\x1b]0;pwned\x07<br>injected".encode()).decode()
+    vm.inbox = [{"read": 0, "subject": evil, "fromAddress": "BM-a",
+                 "toAddress": "BM-b"}]
+    vm.sent = [{"status": "msgqueued", "subject": evil,
+                "toAddress": "BM-b"}]
+    for line in vm.render_inbox(200) + vm.render_sent(200):
+        assert "\x1b" not in line and "\x07" not in line
+        assert "\n" not in line
+
+
+def test_viewmodel_wraps_long_links():
+    from pybitmessage_tpu.viewmodel import ViewModel
+    import base64
+
+    url = "https://example.org/" + "a" * 150
+    vm = ViewModel.__new__(ViewModel)
+    vm.rpc = type("R", (), {"call": lambda *a, **k: "{}"})()
+    vm.inbox = [{"read": 1, "msgid": "00", "subject":
+                 base64.b64encode(b"s").decode(),
+                 "fromAddress": "BM-a", "toAddress": "BM-b",
+                 "message": base64.b64encode(
+                     ("see " + url).encode()).decode()}]
+    lines = vm.render_message(0, 60)
+    marker = next(i for i, ln in enumerate(lines)
+                  if ln.strip() == "Links:")
+    joined = "".join(ln.lstrip() for ln in lines[marker + 1:])
+    assert url in joined, "full link target must survive wrapping"
+    assert all(len(ln) < 60 for ln in lines)
+
+
+def test_blocks_become_newlines():
+    out = sanitize("<h1>Title</h1><ul><li>one</li><li>two</li></ul>")
+    lines = [ln.strip() for ln in out.splitlines() if ln.strip()]
+    assert "Title" in lines[0]
+    assert any("one" in ln for ln in lines)
+    assert any("two" in ln for ln in lines)
