@@ -38,29 +38,21 @@ from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..ops.sha512_jax import DEFAULT_VARIANT, trial_values
-from ..ops.sha512_pallas import (LANE_COLS, DEFAULT_CHUNKS, DEFAULT_ROWS,
-                                 DEFAULT_UNROLL, pallas_batch_search,
-                                 pallas_search)
+from ..ops.sha512_pallas import (BATCH_CHUNKS, LANE_COLS, DEFAULT_CHUNKS,
+                                 DEFAULT_ROWS, DEFAULT_UNROLL,
+                                 pallas_batch_search, pallas_search)
 from ..ops.u64 import U32, add64, le64, mul_u32_const
 from ..ops.pow_search import PowInterrupted
 
 _MASK64 = (1 << 64) - 1
 
-#: chunks >= 1024 fails to compile (BASELINE.md kernel-bounds table)
-_MAX_BATCH_CHUNKS = 512
-
-
-def _batch_chunks(chunks: int, unroll: int) -> int:
-    """Effective grid-chunk count for the pod batch kernel, which runs
-    unroll=1: per-device object counts are unbounded here (B/obj_size),
-    and the unrolled batch body blows the 1 MB SMEM budget beyond ~16
-    objects x 64 chunks (BASELINE.md) — so the per-call trial budget is
-    carried by more chunks instead, clamped at the compile bound.
-    Single source of truth for _get_fn and the host loop's slab/stride
-    accounting.  (The single-chip ``solve_batch`` groups objects <= 16
-    per launch and does use the unroll — a grouping pass here would
-    unlock the same ~38% for the pod tier; future work.)"""
-    return min(chunks * unroll, _MAX_BATCH_CHUNKS)
+#: per-DEVICE object cap for the unrolled batch kernel: beyond ~16
+#: objects x 64 chunks x unroll 4 the kernel exceeds the 1 MB SMEM
+#: budget (BASELINE.md).  The host loop groups the batch so each
+#: device's local share stays within this, mirroring the single-chip
+#: ``solve_batch`` grouping — which is what lets the pod tier run the
+#: same ILP unroll (+38%) as the single-chip batch path.
+POD_BATCH_PER_DEVICE = 16
 
 
 def default_impl() -> str:
@@ -157,6 +149,7 @@ def make_pallas_sharded_search(mesh: Mesh, *, rows: int = DEFAULT_ROWS,
 def make_pallas_sharded_batch_search(mesh: Mesh, *,
                                      rows: int = DEFAULT_ROWS,
                                      chunks: int = DEFAULT_CHUNKS,
+                                     unroll: int = 1,
                                      obj_axis: str | None = None,
                                      nonce_axis: str | None = None,
                                      impl: str = "pallas",
@@ -175,7 +168,7 @@ def make_pallas_sharded_batch_search(mesh: Mesh, *,
         obj_axis = mesh.axis_names[0]
     if nonce_axis is None:
         nonce_axis = mesh.axis_names[-1]
-    slab = rows * LANE_COLS * chunks
+    slab = rows * LANE_COLS * chunks * unroll
 
     def body(ih_words, bases, targets):
         dev = jax.lax.axis_index(nonce_axis).astype(U32)
@@ -189,11 +182,12 @@ def make_pallas_sharded_batch_search(mesh: Mesh, *,
         if impl == "pallas":
             found, nonce = pallas_batch_search(
                 ih_words, local_bases, targets, rows=rows, chunks=chunks,
-                interpret=interpret)
+                unroll=unroll, interpret=interpret)
         else:
             found, nonce = jax.vmap(
                 lambda iw, b, t: _xla_slab(iw, b, t, rows=rows,
-                                           chunks=chunks, variant=variant)
+                                           chunks=chunks * unroll,
+                                           variant=variant)
             )(ih_words, local_bases, targets)
         hit, n_hi, n_lo = jax.vmap(_first_hit)(found, nonce)
         hits = jax.lax.all_gather(hit, nonce_axis)        # (D, B_local)
@@ -228,7 +222,7 @@ def _get_fn(mesh: Mesh, kind: str, rows: int, chunks: int, unroll: int,
                 interpret=interpret, variant=variant)
         else:
             _FN_CACHE[key] = make_pallas_sharded_batch_search(
-                mesh, rows=rows, chunks=_batch_chunks(chunks, unroll),
+                mesh, rows=rows, chunks=chunks, unroll=unroll,
                 impl=impl, interpret=interpret, variant=variant)
     return _FN_CACHE[key]
 
@@ -315,7 +309,7 @@ _ALWAYS_HIT = _MASK64
 
 def pallas_sharded_solve_batch(items, mesh: Mesh, *,
                                rows: int = DEFAULT_ROWS,
-                               chunks_per_call: int = DEFAULT_CHUNKS,
+                               chunks_per_call: int = BATCH_CHUNKS,
                                unroll: int = DEFAULT_UNROLL,
                                impl: str | None = None,
                                interpret: bool = False,
@@ -328,7 +322,10 @@ def pallas_sharded_solve_batch(items, mesh: Mesh, *,
     solves, its target flips to always-hit so its lanes stop after one
     chunk of the next launch, and its trials stop accruing; the batch
     is padded with always-hit dummies (never duplicated real work).
-    Returns ``[(nonce, trials), ...]`` aligned with ``items``.
+    Defaults mirror the single-chip batch geometry (16 objects x 64
+    chunks x 4 streams per device) — the shape validated against the
+    SMEM budget on real hardware.  Returns ``[(nonce, trials), ...]``
+    aligned with ``items``.
     """
     import numpy as np
 
@@ -349,44 +346,51 @@ def pallas_sharded_solve_batch(items, mesh: Mesh, *,
 
     obj_size = mesh.shape[mesh.axis_names[0]]
     nonce_devs = mesh.shape[mesh.axis_names[-1]]
-    pad = -n % obj_size
-    total = n + pad
-    ihs = [ih for ih, _ in items] + [b"\x00" * 64] * pad
-    targets = [t & _MASK64 for _, t in items] + [_ALWAYS_HIT] * pad
-
     fn = _get_fn(mesh, "batch", rows, chunks_per_call, unroll, impl,
                  interpret, variant)
-    ih_words = jnp.stack([_ih_words_arr(ih) for ih in ihs])
-    t_arr = jnp.stack([_pair_arr(t) for t in targets])
-    slab = rows * LANE_COLS * _batch_chunks(chunks_per_call, unroll)
+    slab = rows * LANE_COLS * chunks_per_call * unroll
     stride = nonce_devs * slab
+    # group so each device's local share stays inside the unrolled
+    # kernel's SMEM budget; every group pads to the SAME width, so one
+    # compiled program serves any batch size
+    group_objs = POD_BATCH_PER_DEVICE * obj_size
 
-    bases = [0] * total
-    trials = [0] * total
-    nonces: list[int | None] = [None] * total
-    done = [i >= n for i in range(total)]      # pad slots start done
-    while not all(done):
-        if should_stop is not None and should_stop():
-            raise PowInterrupted("sharded batched Pallas PoW interrupted")
-        b_arr = jnp.stack([_pair_arr(b) for b in bases])
-        packed = np.asarray(fn(ih_words, b_arr, t_arr))
-        found, n_hi, n_lo = packed[:, 0], packed[:, 1], packed[:, 2]
-        for i in range(total):
-            if done[i]:
-                continue
-            trials[i] += stride
-            if found[i]:
-                nonce = (int(n_hi[i]) << 32) | int(n_lo[i])
-                check = double_sha512(nonce.to_bytes(8, "big") + ihs[i])
-                if int.from_bytes(check[:8], "big") > targets[i]:
-                    raise ArithmeticError(
-                        "accelerator returned an invalid nonce")
-                nonces[i] = nonce
-                done[i] = True
-                # flip to always-hit: from the next launch this object's
-                # lanes set their per-object flag at chunk 0 and skip out
-                t_arr = t_arr.at[i].set(
-                    jnp.array([0xFFFFFFFF, 0xFFFFFFFF], dtype=U32))
-            else:
-                bases[i] = (bases[i] + stride) & _MASK64
-    return [(nonces[i], trials[i]) for i in range(n)]
+    results: list = [None] * n
+    for start in range(0, n, group_objs):
+        group = items[start:start + group_objs]
+        pad = group_objs - len(group)
+        ihs = [ih for ih, _ in group] + [b"\x00" * 64] * pad
+        targets = [t & _MASK64 for _, t in group] + [_ALWAYS_HIT] * pad
+        ih_words = jnp.stack([_ih_words_arr(ih) for ih in ihs])
+        t_arr = jnp.stack([_pair_arr(t) for t in targets])
+
+        bases = [0] * group_objs
+        trials = [0] * group_objs
+        done = [i >= len(group) for i in range(group_objs)]
+        while not all(done):
+            if should_stop is not None and should_stop():
+                raise PowInterrupted(
+                    "sharded batched Pallas PoW interrupted")
+            b_arr = jnp.stack([_pair_arr(b) for b in bases])
+            packed = np.asarray(fn(ih_words, b_arr, t_arr))
+            found, n_hi, n_lo = packed[:, 0], packed[:, 1], packed[:, 2]
+            for i in range(group_objs):
+                if done[i]:
+                    continue
+                trials[i] += stride
+                if found[i]:
+                    nonce = (int(n_hi[i]) << 32) | int(n_lo[i])
+                    check = double_sha512(
+                        nonce.to_bytes(8, "big") + ihs[i])
+                    if int.from_bytes(check[:8], "big") > targets[i]:
+                        raise ArithmeticError(
+                            "accelerator returned an invalid nonce")
+                    results[start + i] = (nonce, trials[i])
+                    done[i] = True
+                    # flip to always-hit: from the next launch this
+                    # object's lanes flag out after their first chunk
+                    t_arr = t_arr.at[i].set(
+                        jnp.array([0xFFFFFFFF, 0xFFFFFFFF], dtype=U32))
+                else:
+                    bases[i] = (bases[i] + stride) & _MASK64
+    return results

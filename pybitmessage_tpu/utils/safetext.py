@@ -114,8 +114,14 @@ def sanitize_line(text: str) -> str:
 
 
 def extract_links(body: str) -> list[str]:
-    """URIs found in the (raw) body, deduplicated in order — shown to
-    the user as a separate list, never auto-followed or fetched."""
+    """URIs found in the body, deduplicated in order — shown to the
+    user as a separate list, never auto-followed or fetched.  HTML
+    bodies are entity-decoded first so the listed URL is the one the
+    anchor actually names (``&amp;b=2`` -> ``&b=2``), matching the
+    decoded href sanitize() surfaces inline."""
+    if looks_like_html(body):
+        import html
+        body = html.unescape(body)
     seen = []
     for match in _URI_RE.findall(body):
         if match not in seen:

@@ -170,19 +170,23 @@ class ViewModel:
             f"{sanitize_line(_unb64(m['subject']))}",
             f"{icon[3]}",
         ]
+        step = max(width - 1, 1)     # degenerate widths still progress
         for para in body.splitlines() or [""]:
-            while len(para) >= width:
-                lines.append(para[:width - 1])
-                para = para[width - 1:]
+            while len(para) >= width and len(para) > step:
+                lines.append(para[:step])
+                para = para[step:]
             lines.append(para)
         links = extract_links(raw)
         if links:
             lines.append("")
             lines.append(tr("Links") + ":")
-            # wrap, don't clip: the whole target must be inspectable
+            # wrap, don't clip: the whole target must be inspectable.
+            # The continuation prefix shrinks the line by width-4 per
+            # pass, so degenerate panes (width <= 4) must clip instead
+            # of looping forever.
             for link in links:
                 line = "  " + link
-                while len(line) >= width:
+                while width > 4 and len(line) >= width:
                     lines.append(line[:width - 1])
                     line = "   " + line[width - 1:]
                 lines.append(line)

@@ -24,6 +24,13 @@ def test_german_catalog_roundtrip():
     assert i18n.tr("No such key 123") == "No such key 123"
 
 
+def test_french_catalog_roundtrip():
+    assert "fr" in i18n.available_languages()
+    assert i18n.install("fr") == "fr"
+    assert i18n.tr("Inbox") == "Boîte de réception"
+    assert i18n.tr("Settings") == "Paramètres"
+
+
 def test_placeholder_interpolation():
     i18n.install("de")
     assert i18n.tr("Connections: {count}", count=7) == "Verbindungen: 7"
@@ -39,8 +46,8 @@ def test_unknown_language_falls_back():
 def test_env_language_detection(monkeypatch):
     monkeypatch.setenv("LANGUAGE", "de_DE.UTF-8")
     assert i18n.install() == "de"
-    monkeypatch.setenv("LANGUAGE", "fr")
-    assert i18n.install() == "en"      # no French catalog shipped
+    monkeypatch.setenv("LANGUAGE", "sw")
+    assert i18n.install() == "en"      # no Swahili catalog shipped
 
 
 def test_po_parser_multiline_and_escapes():

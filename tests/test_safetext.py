@@ -119,6 +119,29 @@ def test_viewmodel_wraps_long_links():
     assert all(len(ln) < 60 for ln in lines)
 
 
+def test_html_links_entity_decoded():
+    """The Links list must show the URL the anchor actually names —
+    &amp; left encoded would change the query string (r3 review)."""
+    body = '<a href="http://x.example/p?a=1&amp;b=2">t</a>'
+    assert extract_links(body) == ["http://x.example/p?a=1&b=2"]
+
+
+def test_narrow_pane_link_wrap_terminates():
+    from pybitmessage_tpu.viewmodel import ViewModel
+    import base64
+
+    vm = ViewModel.__new__(ViewModel)
+    vm.rpc = type("R", (), {"call": lambda *a, **k: "{}"})()
+    vm.inbox = [{"read": 1, "msgid": "00",
+                 "subject": base64.b64encode(b"s").decode(),
+                 "fromAddress": "BM-a", "toAddress": "BM-b",
+                 "message": base64.b64encode(
+                     b"https://example.org/long/path").decode()}]
+    for width in (1, 2, 3, 4, 5):
+        lines = vm.render_message(0, width)     # must not hang
+        assert len(lines) < 100
+
+
 def test_blocks_become_newlines():
     out = sanitize("<h1>Title</h1><ul><li>one</li><li>two</li></ul>")
     lines = [ln.strip() for ln in out.splitlines() if ln.strip()]
