@@ -1,0 +1,108 @@
+"""Solver-ladder routing on multi-device meshes (pow/dispatcher.py).
+
+The real-pod tiers (Pallas-sharded single + batch) can't execute on the
+CPU mesh, so these tests pin the ROUTING contract with stubs: which
+tier is tried first, what the fallback order is, and that a Mosaic
+failure latches the Pallas tiers off instead of re-paying a failed
+compile on every solve (reference resetPoW semantics,
+proofofwork.py:173-194)."""
+
+import hashlib
+
+import pytest
+
+from pybitmessage_tpu.pow.dispatcher import PowDispatcher
+
+
+IH = hashlib.sha512(b"routing").digest()
+
+
+@pytest.fixture
+def on_accelerator(monkeypatch):
+    """Pretend the CPU mesh is an 8-chip accelerator pod."""
+    monkeypatch.setattr(PowDispatcher, "_on_accelerator",
+                        lambda self: True)
+
+
+def test_multidev_solve_prefers_pallas_sharded(monkeypatch,
+                                               on_accelerator):
+    import pybitmessage_tpu.parallel as par
+
+    calls = {}
+
+    def fake_sharded(ih, target, mesh, **kw):
+        calls["mesh_devices"] = mesh.devices.size
+        return 1234, 999
+
+    monkeypatch.setattr(par, "pallas_sharded_solve", fake_sharded)
+    d = PowDispatcher(use_native=False)
+    nonce, trials = d.solve(IH, 2**60)
+    assert d.last_backend == "tpu-pallas-sharded"
+    assert (nonce, trials) == (1234, 999)
+    assert calls["mesh_devices"] == 8
+
+
+def test_multidev_solve_falls_back_and_latches(monkeypatch,
+                                               on_accelerator):
+    import pybitmessage_tpu.parallel as par
+
+    attempts = {"n": 0}
+
+    def broken(*a, **k):
+        attempts["n"] += 1
+        raise RuntimeError("mosaic compile failed")
+
+    monkeypatch.setattr(par, "pallas_sharded_solve", broken)
+    d = PowDispatcher(use_native=False)
+    nonce, _ = d.solve(IH, 2**60)          # falls through to XLA sharded
+    assert d.last_backend == "tpu-sharded"
+    from pybitmessage_tpu.utils.hashes import double_sha512
+    check = double_sha512(nonce.to_bytes(8, "big") + IH)
+    assert int.from_bytes(check[:8], "big") <= 2**60
+    # latched: the broken tier is not retried on the next solve
+    d.solve(IH, 2**60)
+    assert attempts["n"] == 1
+    assert d.last_backend == "tpu-sharded"
+
+
+def test_multidev_batch_prefers_pallas_sharded_batch(monkeypatch,
+                                                     on_accelerator):
+    import pybitmessage_tpu.parallel as par
+
+    def fake_batch(items, mesh, **kw):
+        return [(100 + i, 50) for i in range(len(items))]
+
+    monkeypatch.setattr(par, "pallas_sharded_solve_batch", fake_batch)
+    d = PowDispatcher(use_native=False)
+    items = [(hashlib.sha512(b"o%d" % i).digest(), 2**60)
+             for i in range(3)]
+    results = d.solve_batch(items)
+    assert d.last_backend == "tpu-pallas-sharded-batch"
+    assert results == [(100, 50), (101, 50), (102, 50)]
+
+
+def test_multidev_batch_falls_back_to_xla_sharded(monkeypatch,
+                                                  on_accelerator):
+    import pybitmessage_tpu.parallel as par
+
+    monkeypatch.setattr(
+        par, "pallas_sharded_solve_batch",
+        lambda *a, **k: (_ for _ in ()).throw(RuntimeError("boom")))
+    d = PowDispatcher(use_native=False, tpu_kwargs={
+        "lanes": 1 << 12, "chunks_per_call": 8})
+    items = [(hashlib.sha512(b"fb%d" % i).digest(), 2**60)
+             for i in range(2)]
+    results = d.solve_batch(items)
+    assert d.last_backend == "tpu-batch"
+    from pybitmessage_tpu.utils.hashes import double_sha512
+    for (ih, target), (nonce, _) in zip(items, results):
+        check = double_sha512(nonce.to_bytes(8, "big") + ih)
+        assert int.from_bytes(check[:8], "big") <= target
+
+
+def test_cpu_mesh_multidev_uses_xla_sharded():
+    """Without the accelerator pretence the multi-device path routes
+    straight to the XLA sharded tier (the real CPU-mesh behavior)."""
+    d = PowDispatcher(use_native=False)
+    nonce, _ = d.solve(IH, 2**60)
+    assert d.last_backend == "tpu-sharded"
