@@ -43,6 +43,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--no-dandelion", action="store_true")
     p.add_argument("--no-udp", action="store_true",
                    help="disable UDP LAN discovery")
+    p.add_argument("--populate-test-data", action="store_true",
+                   help="seed a deterministic identity + sample inbox "
+                        "message (reference testmode_init role)")
     p.add_argument("--seed-defaults", action="store_true",
                    help="seed the bootstrap nodes into knownnodes")
     p.add_argument("--set", action="append", default=[],
@@ -126,6 +129,10 @@ async def run(args) -> int:
         node.knownnodes.seed_defaults()
 
     await node.start()
+
+    if args.populate_test_data:
+        from .core.testdata import populate
+        populate(node)
 
     upnp_client = None
     if settings.getbool("upnp") and not args.no_listen:

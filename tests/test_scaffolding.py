@@ -169,3 +169,30 @@ def test_plugin_registry_empty_but_queryable():
     for group in KNOWN_GROUPS:
         assert list(iter_plugins(group)) == []
         assert get_plugin(group) is None
+
+
+def test_populate_test_data_idempotent():
+    """testmode_init role (core/testdata.py): deterministic fixture
+    address + addressbook entry + one inbox message, idempotent."""
+    import asyncio
+
+    from pybitmessage_tpu.core import Node
+    from pybitmessage_tpu.core.testdata import SAMPLE_SUBJECT, populate
+
+    async def run():
+        node = Node(listen=False, test_mode=True,
+                    solver=lambda ih, t, should_stop=None: (0, 0))
+        await node.start()
+        try:
+            addr1 = populate(node)
+            addr2 = populate(node)          # idempotent
+            assert addr1 == addr2
+            assert addr1.startswith("BM-")
+            inbox = node.store.inbox()
+            assert len(inbox) == 1
+            assert inbox[0].subject == SAMPLE_SUBJECT
+            assert any(a == addr1 for _, a in node.store.addressbook())
+        finally:
+            await node.stop()
+
+    asyncio.run(run())
