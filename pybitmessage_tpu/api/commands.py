@@ -149,18 +149,23 @@ class CommandHandler:
             "status": "success", "addressVersion": a.version,
             "streamNumber": a.stream, "ripe": _b64(a.ripe)})
 
-    def cmd_listAddresses(self):
+    def _list_addresses(self, encode_label):
         out = []
         for ident in self.node.keystore.identities.values():
             out.append({
-                "label": ident.label, "address": ident.address,
-                "stream": ident.stream, "enabled": ident.enabled,
-                "chan": ident.chan})
+                "label": encode_label(ident.label),
+                "address": ident.address, "stream": ident.stream,
+                "enabled": ident.enabled, "chan": ident.chan})
         return json.dumps({"addresses": out}, indent=4)
 
-    # reference api.py registers listAddresses2 as an alias of
-    # listAddresses (@command('listAddresses', 'listAddresses2'))
-    cmd_listAddresses2 = cmd_listAddresses
+    def cmd_listAddresses(self):
+        return self._list_addresses(lambda label: label)
+
+    def cmd_listAddresses2(self):
+        # reference api.py registers listAddresses2 on the same handler
+        # but base64-encodes labels when invoked under that name
+        # (api.py: if self._method == 'listAddresses2': b64encode(label))
+        return self._list_addresses(_b64)
 
     def cmd_createRandomAddress(self, label, eighteenByteRipe=False,
                                 *_ignored):

@@ -19,10 +19,18 @@ Protocol (all mod the curve order n, G the base point, H = sha256):
 - Requester unblinds: ``s' = s + α``.  Signature is ``(R', s')``.
 - Verify: ``s'·G == R' + H(R' ‖ m)·X``.
 
-The signer never sees ``m`` or the final signature; the requester
-cannot forge without ``x``.  ``SignatureChain`` mirrors the reference's
-eccblindchain role: a root key vouches for intermediate keys which sign
-leaf messages, each link blind-signable.
+The signer never sees ``m`` or the final signature.  Textbook blind
+Schnorr is forgeable when a requester may hold **many concurrent open
+sessions** against the same key (the ROS / parallel-session attack of
+Benhamouda et al. 2021, practical once the requester can open more than
+~log2(n) sessions before any closes).  ``BlindSigner`` therefore
+*serializes* sessions: at most one nonce is outstanding at a time, and
+``new_request`` raises while a session is open.  With sequential
+sessions the scheme is the classic Schnorr blind signature (unforgeable
+in the ROM under the discrete log + one-more-dlog assumption).
+``SignatureChain`` mirrors the reference's eccblindchain role: a root
+key vouches for intermediate keys which sign leaf messages, each link
+blind-signable.
 """
 
 from __future__ import annotations
@@ -121,23 +129,42 @@ class BlindSigner:
     def __init__(self, secret: int | None = None):
         self.secret = secret or (secrets.randbelow(N - 1) + 1)
         self.pub_point = _mul(self.secret)
-        self._nonces: dict[bytes, int] = {}
+        # Single open-session slot: (commitment, r) or None.  Concurrent
+        # open sessions would enable the parallel-session ROS forgery
+        # (see module docstring), so we refuse to open a second one.
+        self._session: tuple[bytes, int] | None = None
 
     @property
     def pubkey(self) -> bytes:
         return _encode_point(self.pub_point)
 
     def new_request(self) -> bytes:
-        """Step 1: a fresh nonce commitment R for one signature."""
+        """Step 1: a fresh nonce commitment R for one signature.
+
+        Raises ``RuntimeError`` if a session is already open — sessions
+        must complete (``sign_blind``) or be abandoned (``abort``)
+        strictly one at a time.
+        """
+        if self._session is not None:
+            raise RuntimeError(
+                "a blind-signing session is already open; concurrent "
+                "sessions enable the ROS parallel-session forgery")
         r = secrets.randbelow(N - 1) + 1
         commitment = _encode_point(_mul(r))
-        self._nonces[commitment] = r
+        self._session = (commitment, r)
         return commitment
+
+    def abort(self) -> None:
+        """Discard the open session (e.g. requester went away)."""
+        self._session = None
 
     def sign_blind(self, commitment: bytes, blinded_challenge: int) -> int:
         """Step 3: s = r + c·x.  The nonce is single-use (a reused
-        Schnorr nonce leaks the key)."""
-        r = self._nonces.pop(commitment)
+        Schnorr nonce leaks the key) and the session closes here."""
+        if self._session is None or self._session[0] != commitment:
+            raise KeyError("no open session for this commitment")
+        r = self._session[1]
+        self._session = None
         return (r + blinded_challenge * self.secret) % N
 
 

@@ -243,31 +243,39 @@ class MessageStore:
     # mode, from anyone NOT whitelisted) before inbox insertion
     # (src/class_objectProcessor.py processmsg, bitmessageqt/blacklist.py).
 
+    # Table names cannot be bound parameters; check against an explicit
+    # allowlist (raises, unlike assert, even under ``python -O``).
+    @staticmethod
+    def _bw_table(which: str) -> str:
+        if which not in ("blacklist", "whitelist"):
+            raise ValueError(f"not a black/whitelist table: {which!r}")
+        return which
+
     def listing(self, which: str) -> list[tuple[str, str, bool]]:
         """(label, address, enabled) rows of 'blacklist' or 'whitelist'."""
-        assert which in ("blacklist", "whitelist")
+        table = self._bw_table(which)
         return [(r[0], r[1], bool(r[2])) for r in self._db.query(
-            "SELECT label, address, enabled FROM %s" % which)]
+            "SELECT label, address, enabled FROM %s" % table)]
 
     def listing_add(self, which: str, address: str, label: str) -> bool:
-        assert which in ("blacklist", "whitelist")
-        if self._db.query("SELECT COUNT(*) FROM %s WHERE address=?" % which,
+        table = self._bw_table(which)
+        if self._db.query("SELECT COUNT(*) FROM %s WHERE address=?" % table,
                           (address,))[0][0]:
             return False
-        self._db.execute("INSERT INTO %s VALUES (?,?,1)" % which,
+        self._db.execute("INSERT INTO %s VALUES (?,?,1)" % table,
                          (label, address))
         return True
 
     def listing_delete(self, which: str, address: str) -> None:
-        assert which in ("blacklist", "whitelist")
-        self._db.execute("DELETE FROM %s WHERE address=?" % which,
-                         (address,))
+        self._db.execute(
+            "DELETE FROM %s WHERE address=?" % self._bw_table(which),
+            (address,))
 
     def listing_set_enabled(self, which: str, address: str,
                             enabled: bool) -> None:
-        assert which in ("blacklist", "whitelist")
-        self._db.execute("UPDATE %s SET enabled=? WHERE address=?" % which,
-                         (int(enabled), address))
+        self._db.execute(
+            "UPDATE %s SET enabled=? WHERE address=?" % self._bw_table(which),
+            (int(enabled), address))
 
     def sender_allowed(self, from_address: str, mode: str) -> bool:
         """Apply the black/whitelist policy to an inbound sender.

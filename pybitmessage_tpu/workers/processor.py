@@ -281,10 +281,10 @@ class ObjectProcessor:
         from_address = encode_address(plain.sender_version,
                                       plain.sender_stream, sender_ripe)
         sighash = sha512(plain.signature)
-        # black/whitelist policy, before any inbox insert
-        # (objectProcessor processmsg; chans bypass the lists there too)
-        if not match.chan and not self.store.sender_allowed(
-                from_address, self.list_mode):
+        # black/whitelist policy, before any inbox insert — applied to
+        # chan recipients too: the reference computes blockMessage
+        # unconditionally for every msg (objectProcessor processmsg)
+        if not self.store.sender_allowed(from_address, self.list_mode):
             logger.info("message from %s dropped by %slist policy",
                         from_address, self.list_mode)
             return
@@ -301,8 +301,11 @@ class ObjectProcessor:
         self.ui_signal("displayNewInboxMessage",
                        (inventory_hash(payload), match.address,
                         from_address, body.subject, body.body))
-        # flood the sender's pre-made ack (objectProcessor.py:723-731)
-        if plain.ack_data and bitfield_does_ack(plain.bitfield):
+        # flood the sender's pre-made ack (objectProcessor.py:723-731);
+        # never for chans — the reference suppresses chan ACKs (every
+        # member holds the key and would re-flood the same ack)
+        if not match.chan and plain.ack_data \
+                and bitfield_does_ack(plain.bitfield):
             await self._emit_ack(plain.ack_data)
 
     async def _emit_ack(self, ack_packet: bytes) -> None:

@@ -111,6 +111,12 @@ def test_address_lifecycle(api_env):
         listing = json.loads(resp["result"])["addresses"]
         assert any(a["address"] == addr and a["label"] == "my label"
                    for a in listing)
+        # listAddresses2 returns the same rows with b64 labels
+        # (reference api.py b64encodes label under that method name)
+        _, resp = await client.call("listAddresses2")
+        listing2 = json.loads(resp["result"])["addresses"]
+        assert any(a["address"] == addr and a["label"] == b64("my label")
+                   for a in listing2)
         # deterministic must be reproducible
         _, r1 = await client.call("getDeterministicAddress", b64("seed x"), 4, 1)
         _, r2 = await client.call("getDeterministicAddress", b64("seed x"), 4, 1)

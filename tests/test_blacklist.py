@@ -104,6 +104,38 @@ async def test_blacklisted_sender_dropped_before_inbox():
 
 
 @pytest.mark.asyncio
+async def test_blacklist_applies_to_chan_recipients():
+    """The reference computes blockMessage unconditionally for every
+    msg recipient — chan or not (class_objectProcessor.py processmsg) —
+    so a blacklisted sender must not reach the user through a chan."""
+    node = Node(listen=False, solver=_test_solver, test_mode=True)
+    await node.start()
+    try:
+        chan = node.keystore.create_deterministic(
+            b"test chan passphrase", "chan: test", chan=True)
+        chan.nonce_trials_per_byte = node.processor.min_ntpb
+        chan.extra_bytes = node.processor.min_extra
+        await node.send_message(chan.address, chan.address, "subj", "body",
+                                ttl=300)
+        assert await _wait_for(
+            lambda: len(node.inventory.unexpired_hashes_by_stream(1)) >= 1
+            and len(node.store.inbox()) == 1)
+        [obj_hash] = node.inventory.unexpired_hashes_by_stream(1)
+        payload = node.inventory[obj_hash].payload
+        node.db.execute("DELETE FROM inbox")
+        node.store.listing_add("blacklist", chan.address, "chan-block")
+        node.processor.queue.put_nowait(payload)
+        await asyncio.sleep(1.5)
+        assert node.store.inbox() == []
+        # control: unblocked, the same chan object delivers
+        node.store.listing_delete("blacklist", chan.address)
+        node.processor.queue.put_nowait(payload)
+        assert await _wait_for(lambda: len(node.store.inbox()) == 1)
+    finally:
+        await node.stop()
+
+
+@pytest.mark.asyncio
 async def test_whitelist_mode_allows_listed_sender():
     node = Node(listen=False, solver=_test_solver, test_mode=True)
     node.processor.list_mode = "white"

@@ -31,12 +31,31 @@ def test_signature_bound_to_key():
 
 
 def test_signer_never_sees_message_or_challenge():
-    """The challenge the signer receives is blinded: two requesters of
-    the SAME message produce different blinded challenges."""
+    """The challenge the signer receives is blinded: two sequential
+    requesters of the SAME message produce different blinded
+    challenges."""
     signer = BlindSigner()
-    c1 = BlindRequester(signer.pubkey, signer.new_request(), b"m")
+    com1 = signer.new_request()
+    c1 = BlindRequester(signer.pubkey, com1, b"m")
+    signer.sign_blind(com1, c1.blinded_challenge)
     c2 = BlindRequester(signer.pubkey, signer.new_request(), b"m")
     assert c1.blinded_challenge != c2.blinded_challenge
+
+
+def test_concurrent_sessions_refused():
+    """Parallel open sessions enable the ROS/Wagner forgery
+    (Benhamouda et al. 2021) against textbook blind Schnorr, so the
+    signer serializes: a second new_request while one is open raises,
+    and abort() frees the slot."""
+    signer = BlindSigner()
+    signer.new_request()
+    with pytest.raises(RuntimeError):
+        signer.new_request()
+    signer.abort()
+    commitment = signer.new_request()      # usable again after abort
+    req = BlindRequester(signer.pubkey, commitment, b"m")
+    sig = req.unblind(signer.sign_blind(commitment, req.blinded_challenge))
+    assert verify(sig, b"m")
 
 
 def test_nonce_single_use():
