@@ -114,6 +114,17 @@ async def run(args) -> int:
     node.ctx.upload_bucket.rate = settings.getint("maxuploadrate") * 1024
     node.pool.max_outbound = settings.getint("maxoutboundconnections")
     node.pool.max_total = settings.getint("maxtotalconnections")
+    node.sender.max_acceptable_ntpb = settings.getint(
+        "maxacceptablenoncetrialsperbyte")
+    node.sender.max_acceptable_extra = settings.getint(
+        "maxacceptablepayloadlengthextrabytes")
+    if settings.get("onionhostname"):
+        # publish our hidden-service endpoint as an ONIONPEER object at
+        # worker startup (reference sendOnionPeerObj)
+        # lowercase: the wire codec round-trips onion hosts in
+        # lowercase, and the self-recognition check compares exactly
+        node.sender.onion_peer = (settings.get("onionhostname").lower(),
+                                  settings.getint("onionport"))
     if settings.get("sockstype") != "none":
         node.ctx.proxy = {
             "type": settings.get("sockstype"),

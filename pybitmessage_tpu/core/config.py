@@ -59,6 +59,7 @@ DEFAULTS: dict[str, str] = {
     "socksauthentication": "false",
     "sockslisten": "false",
     "onionhostname": "",
+    "onionport": "8444",
     "namecoinrpctype": "namecoind",
     "namecoinrpchost": "localhost",
     "namecoinrpcport": "8336",
@@ -70,6 +71,10 @@ DEFAULTS: dict[str, str] = {
     "powlanes": "131072",            # TPU search lanes per chunk
     "powchunks": "32",               # chunks per jitted call
     "blackwhitelist": "black",       # inbound sender policy
+    # ceilings on recipient-demanded PoW; 0 = unlimited (reference
+    # helper_startup sanity cap: ridiculousDifficulty x network default)
+    "maxacceptablenoncetrialsperbyte": "20000000000",
+    "maxacceptablepayloadlengthextrabytes": "20000000000",
     "minimizeonclose": "false",
     "replybelow": "false",
     "timeformat": "%c",

@@ -109,6 +109,7 @@ class Node:
         self.processor = ObjectProcessor(
             keystore=self.keystore, store=self.store,
             inventory=self.inventory, sender=self.sender, pool=self.pool,
+            knownnodes=self.knownnodes,
             shutdown=self.shutdown,
             min_ntpb=min_ntpb, min_extra=min_extra,
             ui_signal=self.ui.emit)

@@ -32,7 +32,13 @@ def encode_host(host: str) -> bytes:
         import base64
         body = host.split(".")[0].upper()
         body += "=" * ((8 - len(body) % 8) % 8)
-        return ONION_PREFIX + base64.b32decode(body)[:10]
+        raw = base64.b32decode(body)
+        if len(raw) != 10:
+            # the 16-byte addr field holds prefix(6)+10 bytes: only
+            # v2-style (16-char) onions are wire-representable —
+            # truncating a v3 onion would flood a garbage address
+            raise MessageError(f"onion host not wire-encodable: {host!r}")
+        return ONION_PREFIX + raw
     try:
         packed = socket.inet_pton(socket.AF_INET, host)
         return b"\x00" * 10 + b"\xff\xff" + packed

@@ -95,6 +95,8 @@ class KnownNodes:
             peers = self._streams.setdefault(stream, {})
             if peer in peers:
                 peers[peer]["lastseen"] = int(lastseen or time.time())
+                if is_self:     # an endpoint first learned via addr
+                    peers[peer]["self"] = True
                 return True
             if len(peers) >= self.max_nodes:
                 return False

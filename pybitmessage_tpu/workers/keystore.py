@@ -41,6 +41,11 @@ class OwnIdentity:
     chan: bool = False
     enabled: bool = True
     last_pubkey_send_time: int = 0
+    #: mailing-list mode: inbound msgs to this identity are re-sent as
+    #: broadcasts titled "[mailinglistname] subject" (reference
+    #: 'mailinglist'/'mailinglistname' per-address config keys)
+    mailinglist: bool = False
+    mailinglistname: str = ""
 
     @property
     def pub_signing_key(self) -> bytes:
@@ -147,7 +152,9 @@ class KeyStore:
     def save(self) -> None:
         if not self._path:
             return
-        cfg = configparser.ConfigParser()
+        # interpolation=None: labels/list names are free text and may
+        # contain '%', which BasicInterpolation would reject
+        cfg = configparser.ConfigParser(interpolation=None)
         cfg.optionxform = str  # base58 addresses are case-sensitive
         for ident in self.identities.values():
             cfg[ident.address] = {
@@ -159,6 +166,8 @@ class KeyStore:
                 "payloadlengthextrabytes": str(ident.extra_bytes),
                 "chan": str(ident.chan).lower(),
                 "lastpubkeysendtime": str(ident.last_pubkey_send_time),
+                "mailinglist": str(ident.mailinglist).lower(),
+                "mailinglistname": ident.mailinglistname,
             }
         if self.subscriptions:
             cfg["subscriptions"] = {
@@ -177,7 +186,7 @@ class KeyStore:
         tmp.replace(self._path)
 
     def load(self) -> None:
-        cfg = configparser.ConfigParser()
+        cfg = configparser.ConfigParser(interpolation=None)
         cfg.optionxform = str  # base58 addresses are case-sensitive
         cfg.read(self._path)
         for section in cfg.sections():
@@ -210,7 +219,9 @@ class KeyStore:
                 int(s.get("payloadlengthextrabytes", DEFAULT_EXTRA_BYTES)),
                 s.get("chan", "false") == "true",
                 s.get("enabled", "true") == "true",
-                int(s.get("lastpubkeysendtime", 0)))
+                int(s.get("lastpubkeysendtime", 0)),
+                s.get("mailinglist", "false") == "true",
+                s.get("mailinglistname", ""))
             self._index(ident)
 
     def touch_pubkey_sent(self, address: str) -> None:

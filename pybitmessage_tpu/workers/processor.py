@@ -19,7 +19,7 @@ from ..crypto.ecies import DecryptionError
 from ..models import msgcoding
 from ..models.constants import (
     DEFAULT_EXTRA_BYTES, DEFAULT_NONCE_TRIALS_PER_BYTE, OBJECT_BROADCAST,
-    OBJECT_GETPUBKEY, OBJECT_MSG, OBJECT_PUBKEY,
+    OBJECT_GETPUBKEY, OBJECT_MSG, OBJECT_ONIONPEER, OBJECT_PUBKEY,
 )
 from ..models.objects import ObjectHeader
 from ..models.payloads import (
@@ -46,6 +46,7 @@ class ObjectProcessor:
 
     def __init__(self, *, keystore: KeyStore, store: MessageStore,
                  inventory, sender: SendWorker, pool=None,
+                 knownnodes=None,
                  shutdown: asyncio.Event | None = None,
                  min_ntpb: int = DEFAULT_NONCE_TRIALS_PER_BYTE,
                  min_extra: int = DEFAULT_EXTRA_BYTES,
@@ -57,6 +58,7 @@ class ObjectProcessor:
         self.inventory = inventory
         self.sender = sender
         self.pool = pool
+        self.knownnodes = knownnodes
         self.shutdown = shutdown or asyncio.Event()
         self.min_ntpb = min_ntpb
         self.min_extra = min_extra
@@ -131,6 +133,40 @@ class ObjectProcessor:
             await self._process_msg(header, payload)
         elif header.object_type == OBJECT_BROADCAST:
             self._process_broadcast(header, payload)
+        elif header.object_type == OBJECT_ONIONPEER:
+            self._process_onionpeer(header, payload)
+
+    # -- onionpeer -----------------------------------------------------------
+
+    def _process_onionpeer(self, header: ObjectHeader,
+                           payload: bytes) -> None:
+        """Type 0x746f72 ("tor"): varint port + 16-byte host — record
+        the peer in knownnodes (class_objectProcessor.py:156-174
+        processonion)."""
+        if self.knownnodes is None:
+            return
+        from ..network.messages import decode_host, is_private_host
+        body = payload[header.header_length:]
+        try:
+            port, n = decode_varint(body, 0)
+            host = decode_host(body[n:n + 16])
+        except Exception:
+            logger.debug("undecodable onionpeer object")
+            return
+        if not (1 <= port <= 65535):
+            return
+        # accept onions always; public IPs only (the reference routes
+        # the host through checkIPAddress, which drops private ranges)
+        if not host.endswith(".onion") and is_private_host(host):
+            return
+        from ..storage.knownnodes import Peer
+        peer = Peer(host, port)
+        own = getattr(self.sender, "onion_peer", None)
+        is_self = own is not None \
+            and (own[0].lower(), own[1]) == (host, port)
+        if self.knownnodes.add(peer, header.stream, is_self=is_self):
+            logger.info("onionpeer recorded: %s:%d (stream %d)",
+                        host, port, header.stream)
 
     # -- acks ----------------------------------------------------------------
 
@@ -301,12 +337,53 @@ class ObjectProcessor:
         self.ui_signal("displayNewInboxMessage",
                        (inventory_hash(payload), match.address,
                         from_address, body.subject, body.body))
+        # mailing-list identities re-send what they receive as a
+        # broadcast to their subscribers (objectProcessor.py:688-721)
+        if match.mailinglist and plain.encoding != 0:
+            self._rebroadcast_to_list(match, from_address,
+                                      body.subject, body.body)
         # flood the sender's pre-made ack (objectProcessor.py:723-731);
         # never for chans — the reference suppresses chan ACKs (every
         # member holds the key and would re-flood the same ack)
         if not match.chan and plain.ack_data \
                 and bitfield_does_ack(plain.bitfield):
             await self._emit_ack(plain.ack_data)
+
+    @staticmethod
+    def _mailing_list_subject(subject: str, name: str) -> str:
+        """'[listname] subject', stripping a leading Re: and avoiding a
+        duplicate prefix (objectProcessor addMailingListNameToSubject)."""
+        subject = subject.strip()
+        if subject[:3].lower() == "re:":
+            subject = subject[3:].strip()
+        if "[" + name + "]" in subject:
+            return subject
+        return "[" + name + "] " + subject
+
+    def _rebroadcast_to_list(self, ident, from_address: str,
+                             subject: str, body: str) -> None:
+        """Queue the received message as a broadcast FROM the list
+        identity, prefixed with the list name and stamped with the
+        ostensible sender (objectProcessor.py:688-721)."""
+        import os
+        from ..models.payloads import gen_ack_payload
+        subject = self._mailing_list_subject(
+            subject, ident.mailinglistname or ident.label)
+        message = (time.strftime("%a, %Y-%m-%d %H:%M:%S UTC", time.gmtime())
+                   + "   Message ostensibly from " + from_address
+                   + ":\n\n" + body)
+        ack = gen_ack_payload(ident.stream, 0)
+        self.store.queue_sent(
+            msgid=os.urandom(16), toaddress="[Broadcast subscribers]",
+            toripe=b"", fromaddress=ident.address, subject=subject,
+            message=message, ackdata=ack, ttl=4 * 24 * 3600,
+            status="broadcastqueued")
+        self.ui_signal("displayNewSentMessage",
+                       ("[Broadcast subscribers]", "[Broadcast subscribers]",
+                        ident.address, subject, message, ack))
+        self.sender.queue.put_nowait(("sendbroadcast",))
+        logger.info("mailing list %s rebroadcasting message from %s",
+                    ident.address, from_address)
 
     async def _emit_ack(self, ack_packet: bytes) -> None:
         """The embedded ack is a full wire packet; strip the 24-byte

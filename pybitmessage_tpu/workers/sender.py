@@ -24,7 +24,7 @@ from ..crypto.ecies import DecryptionError
 from ..models import msgcoding
 from ..models.constants import (
     DEFAULT_EXTRA_BYTES, DEFAULT_NONCE_TRIALS_PER_BYTE, OBJECT_MSG,
-    OBJECT_PUBKEY, RIDICULOUS_DIFFICULTY,
+    OBJECT_ONIONPEER, OBJECT_PUBKEY, RIDICULOUS_DIFFICULTY,
 )
 from ..models.payloads import (
     MsgPlaintext, BroadcastPlaintext, PayloadError, PubkeyData,
@@ -80,6 +80,18 @@ class SendWorker:
         self.watched_acks: set[bytes] = set()
         #: tag -> address for pubkeys we await (state.neededPubkeys analog)
         self.needed_pubkeys: dict[bytes, str] = {}
+        #: (host, port) of our own onion endpoint; when set, start()
+        #: publishes it as an ONIONPEER object (sendOnionPeerObj role)
+        self.onion_peer: tuple[str, int] | None = None
+        #: user-configurable ceilings on a recipient's demanded PoW
+        #: (reference maxacceptablenoncetrialsperbyte /
+        #: maxacceptablepayloadlengthextrabytes; 0 = unlimited, and the
+        #: default matches the reference's ridiculousDifficulty x
+        #: network-default sanity cap, helper_startup.py:225-240)
+        self.max_acceptable_ntpb = \
+            RIDICULOUS_DIFFICULTY * DEFAULT_NONCE_TRIALS_PER_BYTE
+        self.max_acceptable_extra = \
+            RIDICULOUS_DIFFICULTY * DEFAULT_EXTRA_BYTES
         self._task: asyncio.Task | None = None
 
     # -- lifecycle -----------------------------------------------------------
@@ -92,6 +104,11 @@ class SendWorker:
         # for a new command (reference worker startup behavior)
         self.queue.put_nowait(("sendmessage",))
         self.queue.put_nowait(("sendbroadcast",))
+        # announce our onion endpoint, if configured (the reference
+        # enqueues 'sendOnionPeerObj' at worker startup the same way,
+        # class_singleWorker.py:142-143)
+        if self.onion_peer:
+            self.queue.put_nowait(("sendonionpeer",))
         self._task = asyncio.create_task(self._run())
         return self._task
 
@@ -136,6 +153,8 @@ class SendWorker:
             await self.process_queued_broadcasts()
         elif kind == "sendpubkey":
             await self.send_my_pubkey(cmd[1])
+        elif kind == "sendonionpeer":
+            await self.send_onion_peer(*cmd[1:])
         else:
             logger.warning("unknown worker command %r", kind)
 
@@ -209,9 +228,17 @@ class SendWorker:
                 return
             their_ntpb = max(pubkey.nonce_trials_per_byte, self.min_ntpb)
             their_extra = max(pubkey.extra_bytes, self.min_extra)
-            if their_ntpb > RIDICULOUS_DIFFICULTY or \
-                    their_extra > RIDICULOUS_DIFFICULTY:
+            # refuse recipients demanding more work than the user is
+            # willing to do — 'forcepow' overrides, 0 means unlimited
+            # (class_singleWorker.py:1060-1091)
+            if m.status != "forcepow" and (
+                    (self.max_acceptable_ntpb
+                     and their_ntpb > self.max_acceptable_ntpb)
+                    or (self.max_acceptable_extra
+                        and their_extra > self.max_acceptable_extra)):
                 self.store.update_sent_status(m.ackdata, "toodifficult")
+                self.ui_signal("updateSentItemStatusByAckdata",
+                               (m.ackdata, "toodifficult"))
                 return
             pub_enc = pubkey.pub_encryption_key
             their_bitfield_acks = bitfield_does_ack(pubkey.bitfield)
@@ -372,6 +399,39 @@ class SendWorker:
         self._publish(payload, OBJECT_PUBKEY, ident.stream, tag)
         self.keystore.touch_pubkey_sent(address)
         logger.info("published pubkey for %s", address)
+
+    # -- onionpeer announcement ----------------------------------------------
+
+    async def send_onion_peer(self, peer: tuple[str, int] | None = None,
+                              stream: int = 1) -> None:
+        """Flood an ONIONPEER object naming an onion endpoint — ours by
+        default (reference sendOnionPeerObj,
+        class_singleWorker.py:494-530).  Body: varint port + 16-byte
+        encoded host; dedup by tag so an unexpired copy isn't redone."""
+        peer = peer or self.onion_peer
+        if not peer:
+            return
+        host, port = peer
+        from ..network.messages import encode_host
+        try:
+            body = encode_varint(port) + encode_host(host)
+        except Exception:
+            logger.warning("cannot encode onion endpoint %r", host)
+            return
+        tag = inventory_hash(body)
+        if any(item.expires > time.time() for item in
+               self.inventory.by_type_and_tag(OBJECT_ONIONPEER, tag)):
+            return          # an unexpired announcement is circulating
+        ttl = _jitter_ttl(7 * 24 * 3600)
+        expires = int(time.time()) + ttl
+        # object version 2 for v2 onions (22-char hostname), else 3
+        # (matches the reference's wire choice)
+        version = 2 if len(host) == 22 else 3
+        payload = object_shell(expires, OBJECT_ONIONPEER, version,
+                               stream) + body
+        payload = await self._do_pow(payload, ttl)
+        self._publish(payload, OBJECT_ONIONPEER, stream, tag)
+        logger.info("published onionpeer object for %s:%d", host, port)
 
     # -- broadcast sending ---------------------------------------------------
 

@@ -1,0 +1,97 @@
+"""Configurable max-acceptable-difficulty refusal: a recipient whose
+demanded PoW exceeds the user's ceiling goes 'toodifficult' instead of
+burning compute; 'forcepow' overrides (reference
+class_singleWorker.py:1060-1091).
+"""
+
+import asyncio
+import time
+
+import pytest
+
+from pybitmessage_tpu.core import Node
+from pybitmessage_tpu.ops import solve
+from pybitmessage_tpu.storage import Peer
+
+
+def _test_solver(initial_hash, target, should_stop=None):
+    return solve(initial_hash, target, lanes=4096, chunks_per_call=16,
+                 should_stop=should_stop)
+
+
+def _make_node(**kw):
+    return Node(listen=kw.pop("listen", True), solver=_test_solver,
+                test_mode=True, allow_private_peers=True,
+                dandelion_enabled=False, **kw)
+
+
+async def _wait_for(predicate, timeout=60.0, interval=0.1):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if predicate():
+            return True
+        await asyncio.sleep(interval)
+    return False
+
+
+@pytest.mark.asyncio
+async def test_toodifficult_at_configured_threshold_and_forcepow():
+    """Bob demands 4x the test-mode minimum; Alice's configured ceiling
+    sits below that -> 'toodifficult' at HER threshold (not the
+    hard-coded ridiculous cap).  Forcing PoW then sends anyway."""
+    node_a = _make_node()
+    node_b = _make_node()
+    await node_a.start()
+    await node_b.start()
+    try:
+        alice = node_a.create_identity("alice")
+        bob = node_b.create_identity("bob")
+        bob.nonce_trials_per_byte = node_b.processor.min_ntpb * 4
+        bob.extra_bytes = node_b.processor.min_extra
+        # Alice accepts at most 2x the minimum
+        node_a.sender.max_acceptable_ntpb = node_a.sender.min_ntpb * 2
+        # B must accept the eventual 4x-difficulty msg object
+        node_b.processor.min_ntpb = bob.nonce_trials_per_byte
+
+        conn = await node_a.pool.connect_to(
+            Peer("127.0.0.1", node_b.pool.listen_port))
+        assert conn is not None
+        assert await _wait_for(lambda: conn.fully_established)
+
+        ack = await node_a.send_message(bob.address, alice.address,
+                                        "hard subj", "hard body", ttl=300)
+        assert await _wait_for(
+            lambda: node_a.message_status(ack) == "toodifficult",
+            timeout=90), "refusal never triggered"
+        assert node_b.store.inbox() == []
+
+        # forcepow overrides the ceiling (reference status check)
+        node_a.store.update_sent_status(ack, "forcepow")
+        await node_a.sender.queue.put(("sendmessage",))
+        assert await _wait_for(
+            lambda: len(node_b.store.inbox()) == 1, timeout=120), \
+            "forcepow send never arrived"
+        assert node_b.store.inbox()[0].subject == "hard subj"
+    finally:
+        await node_a.stop()
+        await node_b.stop()
+
+
+@pytest.mark.asyncio
+async def test_zero_ceiling_means_unlimited():
+    """With the knobs at 0 the old behavior returns: any demanded
+    difficulty under the ridiculous cap is attempted."""
+    node = _make_node(listen=False)
+    await node.start()
+    try:
+        node.sender.max_acceptable_ntpb = 0
+        node.sender.max_acceptable_extra = 0
+        me = node.create_identity("me")
+        me.nonce_trials_per_byte = node.processor.min_ntpb
+        me.extra_bytes = node.processor.min_extra
+        ack = await node.send_message(me.address, me.address, "s", "b",
+                                      ttl=300)
+        assert await _wait_for(
+            lambda: node.message_status(ack) == "ackreceived")
+    finally:
+        await node.stop()

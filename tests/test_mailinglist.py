@@ -1,0 +1,91 @@
+"""Mailing-list identities: inbound msgs re-sent as broadcasts with a
+"[listname]" subject (reference class_objectProcessor.py:688-721 and
+addMailingListNameToSubject :1057-1064).
+"""
+
+import asyncio
+import time
+
+import pytest
+
+from pybitmessage_tpu.core import Node
+from pybitmessage_tpu.ops import solve
+from pybitmessage_tpu.storage import Peer
+from pybitmessage_tpu.workers.processor import ObjectProcessor
+
+
+def _test_solver(initial_hash, target, should_stop=None):
+    return solve(initial_hash, target, lanes=4096, chunks_per_call=16,
+                 should_stop=should_stop)
+
+
+def _make_node(**kw):
+    return Node(listen=kw.pop("listen", True), solver=_test_solver,
+                test_mode=True, allow_private_peers=True,
+                dandelion_enabled=False, **kw)
+
+
+async def _wait_for(predicate, timeout=60.0, interval=0.1):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if predicate():
+            return True
+        await asyncio.sleep(interval)
+    return False
+
+
+def test_mailing_list_subject_prefixing():
+    f = ObjectProcessor._mailing_list_subject
+    assert f("hello", "mylist") == "[mylist] hello"
+    assert f("Re: hello", "mylist") == "[mylist] hello"
+    assert f("RE:   hello", "mylist") == "[mylist] hello"
+    # already tagged: no double prefix
+    assert f("[mylist] hello", "mylist") == "[mylist] hello"
+    assert f("Re: [mylist] hello", "mylist") == "[mylist] hello"
+
+
+@pytest.mark.asyncio
+async def test_message_to_mailing_list_rebroadcasts_to_subscriber():
+    """A sends a msg to B's mailing-list identity; B re-sends it as a
+    broadcast; A (a subscriber of the list) receives the broadcast —
+    the VERDICT round-3 'done' criterion."""
+    node_a = _make_node()
+    node_b = _make_node()
+    await node_a.start()
+    await node_b.start()
+    try:
+        alice = node_a.create_identity("alice")
+        mlist = node_b.create_identity("the list")
+        mlist.mailinglist = True
+        mlist.mailinglistname = "mylist"
+        # align demanded difficulty with the network minimum so the
+        # processor's demanded-PoW recheck accepts the wire object
+        mlist.nonce_trials_per_byte = node_b.processor.min_ntpb
+        mlist.extra_bytes = node_b.processor.min_extra
+        node_a.keystore.subscribe(mlist.address, "my list feed")
+
+        conn = await node_a.pool.connect_to(
+            Peer("127.0.0.1", node_b.pool.listen_port))
+        assert conn is not None
+        assert await _wait_for(lambda: conn.fully_established)
+
+        await node_a.send_message(mlist.address, alice.address,
+                                  "list topic", "list body", ttl=300)
+        # the list node delivers the msg to its own inbox...
+        assert await _wait_for(
+            lambda: len(node_b.store.inbox()) > 0, timeout=90), \
+            "msg never reached the mailing-list identity"
+        # ...and the rebroadcast reaches the subscriber as a broadcast
+        assert await _wait_for(
+            lambda: any(m.toaddress == "[Broadcast]"
+                        for m in node_a.store.inbox()), timeout=90), \
+            "rebroadcast never reached the subscriber"
+        bcast = [m for m in node_a.store.inbox()
+                 if m.toaddress == "[Broadcast]"][0]
+        assert bcast.subject == "[mylist] list topic"
+        assert bcast.fromaddress == mlist.address
+        assert "Message ostensibly from " + alice.address in bcast.message
+        assert "list body" in bcast.message
+    finally:
+        await node_a.stop()
+        await node_b.stop()
