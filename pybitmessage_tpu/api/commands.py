@@ -129,10 +129,33 @@ class CommandHandler:
     def cmd_getStatusBar(self):
         """Testmode helper (reference api.py @testmode('getStatusBar')):
         last updateStatusBar text pushed through the UI signaler."""
-        for command, data in reversed(self.node.ui.recent):
+        for _seq, command, data in reversed(self.node.ui.recent):
             if command == "updateStatusBar" and data:
                 return data[0]
         return ""
+
+    async def cmd_waitForEvents(self, since=0, timeout=20):
+        """Long-poll the UISignal stream (the event-driven frontend
+        contract, reference bitmessageqt/uisignaler.py:8-60 — but over
+        the API so out-of-process frontends need not refresh-poll).
+
+        Returns ``{"events": [{"seq", "command", "data"}...], "next"}``
+        immediately when events newer than ``since`` are buffered,
+        otherwise after the first new event or ``timeout`` seconds
+        (capped at 60).  Pass ``next`` back as ``since`` to resume."""
+        try:
+            since = int(since)
+            timeout = min(float(timeout), 60.0)
+        except (TypeError, ValueError):
+            raise APIError(0, "since/timeout must be numeric")
+        events = await self.node.ui.wait_for_events(since, timeout)
+        out = [{"seq": s, "command": c,
+                "data": [x.hex() if isinstance(x, (bytes, bytearray))
+                         else x for x in d]}
+               for s, c, d in events]
+        return json.dumps({
+            "events": out,
+            "next": events[-1][0] if events else since})
 
     def cmd_clearUISignalQueue(self):
         """Testmode helper: drop buffered UI events (the reference
@@ -155,8 +178,24 @@ class CommandHandler:
             out.append({
                 "label": encode_label(ident.label),
                 "address": ident.address, "stream": ident.stream,
-                "enabled": ident.enabled, "chan": ident.chan})
+                "enabled": ident.enabled, "chan": ident.chan,
+                "mailinglist": ident.mailinglist,
+                "mailinglistname": ident.mailinglistname})
         return json.dumps({"addresses": out}, indent=4)
+
+    def cmd_setMailingList(self, address, enabled, name=""):
+        """Extension: toggle mailing-list mode on an own identity (the
+        reference's per-address 'mailinglist'/'mailinglistname' config
+        keys, set from the Qt identities context menu)."""
+        ident = self.node.keystore.get(address)
+        if ident is None:
+            raise APIError(13)
+        if not isinstance(enabled, bool):
+            raise APIError(23)
+        ident.mailinglist = enabled
+        ident.mailinglistname = _from_b64(name, 17) if name else ""
+        self.node.keystore.save()
+        return "success"
 
     def cmd_listAddresses(self):
         return self._list_addresses(lambda label: label)

@@ -193,14 +193,8 @@ class Node:
     async def send_broadcast(self, from_address: str, subject: str,
                              body: str, *, ttl: int = 4 * 24 * 3600,
                              encoding: int = 2) -> bytes:
-        ack = gen_ack_payload(1, 0)
-        self.store.queue_sent(
-            msgid=os.urandom(16), toaddress="[Broadcast]", toripe=b"",
-            fromaddress=from_address, subject=subject, message=body,
-            ackdata=ack, ttl=ttl, encoding=encoding,
-            status="broadcastqueued")
-        await self.sender.queue.put(("sendbroadcast",))
-        return ack
+        return self.sender.queue_broadcast(from_address, subject, body,
+                                           ttl=ttl, encoding=encoding)
 
     def message_status(self, ackdata: bytes) -> str:
         m = self.store.sent_by_ackdata(ackdata)

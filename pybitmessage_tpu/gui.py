@@ -27,9 +27,14 @@ import sys
 from .cli import CommandError, RPCClient
 from .core.i18n import install as i18n_install, tr
 from .utils.identicon import derive
-from .viewmodel import ViewModel, _unb64
+from .viewmodel import EventPump, ViewModel, _unb64
 
-REFRESH_MS = 3000
+#: UI tick — only checks the event pump's flag (no RPC); a real
+#: refresh happens when the long-poll delivered events, giving
+#: sub-second new-message latency instead of 3 s interval polling
+TICK_MS = 200
+#: safety-net full refresh (covers a dropped long-poll connection)
+FALLBACK_REFRESH_MS = 30000
 
 #: settings exposed in the dialog, in display order (reference
 #: bitmessageqt/settings.py covers the same groups: network, rates,
@@ -444,13 +449,23 @@ class BMApp:  # pragma: no cover - thin widget shell; logic is GUIController
 
     def run(self) -> int:
         self.ctl.refresh()
+        # event-driven: a waitForEvents long-poll replaces the old
+        # 3-second RPC polling (uisignaler contract over the API)
+        pump = EventPump(self.ctl.vm.rpc).start()
+        overdue = [0]
 
         def tick():
-            self.ctl.refresh()
-            self.root.after(REFRESH_MS, tick)
+            overdue[0] += TICK_MS
+            if pump.pending() or overdue[0] >= FALLBACK_REFRESH_MS:
+                overdue[0] = 0
+                self.ctl.refresh()
+            self.root.after(TICK_MS, tick)
 
-        self.root.after(REFRESH_MS, tick)
-        self.root.mainloop()
+        self.root.after(TICK_MS, tick)
+        try:
+            self.root.mainloop()
+        finally:
+            pump.stop()
         return 0
 
 

@@ -273,8 +273,10 @@ class BMConnection:
                     continue
                 try:
                     encode_host(p.host)
-                except OSError:
-                    continue  # DNS bootstrap names are not wire-encodable
+                except (OSError, ValueError):
+                    # DNS bootstrap names / v3 onions aren't
+                    # wire-encodable
+                    continue
                 entries.append(AddrEntry(
                     info["lastseen"], stream, 1, p.host, p.port))
         if entries:

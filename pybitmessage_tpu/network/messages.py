@@ -97,7 +97,13 @@ class VersionPayload:
         out += struct.pack(">q", self.timestamp or int(time.time()))
         # addrRecv: the peer as we see it (services ignored remotely)
         out += struct.pack(">q", self.remote_services)
-        out += encode_host(self.remote_host)[:16]
+        try:
+            host16 = encode_host(self.remote_host)[:16]
+        except (OSError, ValueError):
+            # proxied hostname / v3 onion: not wire-encodable — send a
+            # placeholder; the peer keys off the socket address anyway
+            host16 = b"\x00" * 10 + b"\xff\xff" + b"\x7f\x00\x00\x01"
+        out += host16
         out += struct.pack(">H", self.remote_port)
         # addrFrom: our services + a placeholder loopback address — the
         # peer uses the real socket address (reference protocol.py:344-347)

@@ -336,8 +336,10 @@ class ConnectionPool:
                     continue
                 try:
                     encode_host(peer.host)
-                except OSError:
-                    continue  # DNS bootstrap names aren't wire-encodable
+                except (OSError, ValueError):
+                    # DNS bootstrap names / v3 onions aren't
+                    # wire-encodable
+                    continue
                 entry = AddrEntry(info["lastseen"], stream, 1,
                                   peer.host, peer.port)
                 self._addr_gossip.append(

@@ -24,7 +24,9 @@ import sys
 
 from .cli import RPCClient, CommandError
 from .core.i18n import install as i18n_install
-from .viewmodel import PANES, ViewModel, _b64, _clip, _unb64  # noqa: F401
+from .viewmodel import (  # noqa: F401
+    EventPump, PANES, ViewModel, _b64, _clip, _unb64,
+)
 
 
 def render_frame(vm: ViewModel, pane: str, selected: int, width: int,
@@ -52,16 +54,25 @@ def run(rpc: RPCClient) -> int:  # pragma: no cover - needs a tty
 
     def prompt(stdscr, label: str) -> str:
         curses.echo()
+        # text entry must block: the event-pump getch timeout would
+        # make getstr return early/truncated between keystrokes
+        stdscr.timeout(-1)
         h, w = stdscr.getmaxyx()
         stdscr.addstr(h - 1, 0, " " * (w - 1))
         stdscr.addstr(h - 1, 0, label)
         stdscr.refresh()
         value = stdscr.getstr(h - 1, len(label), 512).decode()
         curses.noecho()
+        stdscr.timeout(250)
         return value
+
+    # event-driven refresh: waitForEvents long-poll instead of interval
+    # polling; getch gains a timeout so pump events repaint promptly
+    pump = EventPump(rpc).start()
 
     def loop(stdscr):
         curses.curs_set(0)
+        stdscr.timeout(250)
         pane_i, selected = 0, 0
         message_index = None
         status_line = "r refresh  n new  b broadcast  a address  " \
@@ -78,6 +89,13 @@ def run(rpc: RPCClient) -> int:  # pragma: no cover - needs a tty
                           curses.A_REVERSE)
             stdscr.refresh()
             key = stdscr.getch()
+            if key == -1:               # getch timeout tick
+                if pump.pending():
+                    try:
+                        vm.refresh()
+                    except CommandError as exc:
+                        status_line = f"error: {exc}"
+                continue
             if key in (ord("q"), 27) and message_index is None:
                 return 0
             if key in (ord("q"), 27):
@@ -150,7 +168,10 @@ def run(rpc: RPCClient) -> int:  # pragma: no cover - needs a tty
             elif key == ord("r"):
                 vm.refresh()
 
-    return curses.wrapper(loop)
+    try:
+        return curses.wrapper(loop)
+    finally:
+        pump.stop()
 
 
 def main(argv=None) -> int:  # pragma: no cover - needs a tty

@@ -22,6 +22,65 @@ PANES = ("Inbox", "Sent", "Identities", "Subscriptions", "Addressbook",
          "Blacklist", "Network")
 
 
+class EventPump:
+    """Background ``waitForEvents`` long-poller for frontends.
+
+    Replaces interval refresh-polling: a daemon thread holds one
+    long-poll open against the API; when events arrive it sets a flag
+    (and invokes ``on_events``, from the pump thread) so the UI loop
+    can refresh immediately instead of on a 3-second timer.  The server
+    side is ``cmd_waitForEvents`` riding the in-process UISignaler
+    (reference contract: bitmessageqt/uisignaler.py:8-60).
+    """
+
+    def __init__(self, rpc: RPCClient, on_events=None,
+                 poll_timeout: float = 20.0):
+        # dedicated client: the long-poll must not hold up the UI's
+        # own RPC calls (each call opens its own connection anyway)
+        self.rpc = RPCClient(rpc.host, rpc.port)
+        self.rpc.auth = rpc.auth
+        self.on_events = on_events
+        self.poll_timeout = poll_timeout
+        self.since = 0
+        self._pending = False
+        self._stop = False
+        self._thread = None
+
+    def start(self) -> "EventPump":
+        import threading
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="bm-event-pump")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop = True
+
+    def pending(self) -> bool:
+        """True once if events arrived since the last check."""
+        was, self._pending = self._pending, False
+        return was
+
+    def _run(self) -> None:
+        import time as _time
+        while not self._stop:
+            try:
+                resp = json.loads(self.rpc.call(
+                    "waitForEvents", self.since, self.poll_timeout))
+            except Exception:
+                _time.sleep(2.0)     # API restarting / unreachable
+                continue
+            self.since = resp.get("next", self.since)
+            events = resp.get("events", [])
+            if events:
+                self._pending = True
+                if self.on_events is not None:
+                    try:
+                        self.on_events(events)
+                    except Exception:
+                        pass
+
+
 def _clip(s: str, width: int) -> str:
     return s[:width - 1] if width > 0 else ""
 

@@ -365,23 +365,17 @@ class ObjectProcessor:
         """Queue the received message as a broadcast FROM the list
         identity, prefixed with the list name and stamped with the
         ostensible sender (objectProcessor.py:688-721)."""
-        import os
-        from ..models.payloads import gen_ack_payload
         subject = self._mailing_list_subject(
             subject, ident.mailinglistname or ident.label)
         message = (time.strftime("%a, %Y-%m-%d %H:%M:%S UTC", time.gmtime())
                    + "   Message ostensibly from " + from_address
                    + ":\n\n" + body)
-        ack = gen_ack_payload(ident.stream, 0)
-        self.store.queue_sent(
-            msgid=os.urandom(16), toaddress="[Broadcast subscribers]",
-            toripe=b"", fromaddress=ident.address, subject=subject,
-            message=message, ackdata=ack, ttl=4 * 24 * 3600,
-            status="broadcastqueued")
+        ack = self.sender.queue_broadcast(
+            ident.address, subject, message,
+            toaddress="[Broadcast subscribers]")
         self.ui_signal("displayNewSentMessage",
                        ("[Broadcast subscribers]", "[Broadcast subscribers]",
                         ident.address, subject, message, ack))
-        self.sender.queue.put_nowait(("sendbroadcast",))
         logger.info("mailing list %s rebroadcasting message from %s",
                     ident.address, from_address)
 

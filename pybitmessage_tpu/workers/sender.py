@@ -400,6 +400,25 @@ class SendWorker:
         self.keystore.touch_pubkey_sent(address)
         logger.info("published pubkey for %s", address)
 
+    def queue_broadcast(self, fromaddress: str, subject: str,
+                        message: str, *, ttl: int = 4 * 24 * 3600,
+                        encoding: int = 2,
+                        toaddress: str = "[Broadcast]") -> bytes:
+        """Enqueue a broadcast row and nudge the worker; the single
+        owner of the queued-broadcast contract (helper_sent.insert with
+        status='broadcastqueued') for Node.send_broadcast and the
+        mailing-list rebroadcast path alike."""
+        import os
+        from ..models.payloads import gen_ack_payload
+        ack = gen_ack_payload(1, 0)
+        self.store.queue_sent(
+            msgid=os.urandom(16), toaddress=toaddress, toripe=b"",
+            fromaddress=fromaddress, subject=subject, message=message,
+            ackdata=ack, ttl=ttl, encoding=encoding,
+            status="broadcastqueued")
+        self.queue.put_nowait(("sendbroadcast",))
+        return ack
+
     # -- onionpeer announcement ----------------------------------------------
 
     async def send_onion_peer(self, peer: tuple[str, int] | None = None,

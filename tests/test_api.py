@@ -128,6 +128,93 @@ def test_address_lifecycle(api_env):
     run_api_test(api_env, body)
 
 
+def test_set_mailing_list(api_env):
+    """Mailing-list mode is reachable over the API (not only by poking
+    keystore objects in-process)."""
+    async def body(client, node):
+        _, resp = await client.call("createRandomAddress", b64("list"))
+        addr = resp["result"]
+        _, resp = await client.call("setMailingList", addr, True,
+                                    b64("mylist"))
+        assert resp["result"] == "success"
+        ident = node.keystore.get(addr)
+        assert ident.mailinglist and ident.mailinglistname == "mylist"
+        _, resp = await client.call("listAddresses")
+        [a] = [a for a in json.loads(resp["result"])["addresses"]
+               if a["address"] == addr]
+        assert a["mailinglist"] is True
+        assert a["mailinglistname"] == "mylist"
+        _, resp = await client.call("setMailingList", addr, False)
+        assert not node.keystore.get(addr).mailinglist
+        # unknown address and non-bool enabled are refused
+        _, resp = await client.call("setMailingList", "BM-nonexistent",
+                                    True)
+        assert "error" in resp
+        _, resp = await client.call("setMailingList", addr, "yes")
+        assert "error" in resp
+    run_api_test(api_env, body)
+
+
+def test_wait_for_events_long_poll(api_env):
+    """The uisignaler-over-API contract: a parked waitForEvents client
+    receives displayNewInboxMessage in <0.5 s of the emit — no
+    interval polling (VERDICT round-3 'done' criterion)."""
+    async def body(client, node):
+        import time
+
+        async def parked_poll():
+            return await client.call("waitForEvents", 0, 10)
+
+        task = asyncio.create_task(parked_poll())
+        await asyncio.sleep(0.3)          # ensure the poll is parked
+        assert not task.done()
+        t0 = time.monotonic()
+        node.ui.emit("displayNewInboxMessage",
+                     (b"\x01\x02", "BM-to", "BM-from", "subj", "body"))
+        _, resp = await asyncio.wait_for(task, 5)
+        latency = time.monotonic() - t0
+        assert latency < 0.5, f"event took {latency:.3f}s"
+        payload = json.loads(resp["result"])
+        [ev] = payload["events"]
+        assert ev["command"] == "displayNewInboxMessage"
+        assert ev["data"][0] == "0102"    # bytes hex-encoded
+        assert ev["data"][3] == "subj"
+        assert payload["next"] == ev["seq"]
+
+        # cursor semantics: buffered events return immediately;
+        # resuming from `next` blocks until something new happens
+        node.ui.emit("updateStatusBar", ("hello",))
+        _, resp = await client.call("waitForEvents", payload["next"], 10)
+        p2 = json.loads(resp["result"])
+        assert [e["command"] for e in p2["events"]] == ["updateStatusBar"]
+        _, resp = await client.call("waitForEvents", p2["next"], 0)
+        assert json.loads(resp["result"])["events"] == []
+    run_api_test(api_env, body)
+
+
+def test_event_pump_drives_refresh(api_env):
+    """viewmodel.EventPump (the frontends' long-poll thread) flips its
+    pending flag promptly on an emitted event."""
+    async def body(client, node):
+        import time
+        from pybitmessage_tpu.cli import RPCClient
+        from pybitmessage_tpu.viewmodel import EventPump
+        rpc = RPCClient("127.0.0.1", client.port, "user", "pass")
+        pump = EventPump(rpc, poll_timeout=5).start()
+        try:
+            await asyncio.sleep(0.5)      # pump's first poll is parked
+            t0 = time.monotonic()
+            node.ui.emit("displayNewInboxMessage",
+                         (b"\x03", "t", "f", "s", "b"))
+            while not pump.pending():
+                assert time.monotonic() - t0 < 2.0, "pump never woke"
+                await asyncio.sleep(0.02)
+            assert time.monotonic() - t0 < 0.5
+        finally:
+            pump.stop()
+    run_api_test(api_env, body)
+
+
 def test_addressbook_and_subscriptions(api_env):
     async def body(client, node):
         ident = node.create_identity("peer")
