@@ -125,7 +125,19 @@ async def run(args) -> int:
         # lowercase, and the self-recognition check compares exactly
         node.sender.onion_peer = (settings.get("onionhostname").lower(),
                                   settings.getint("onionport"))
-    if settings.get("sockstype") != "none":
+    if settings.get("sockstype") not in ("none", "SOCKS5", "SOCKS4a"):
+        # a plugin name (e.g. "stem"): let it launch/adopt a proxy and
+        # rewrite the socks settings (reference start_proxyconfig).
+        # FAIL CLOSED: the user asked for proxied traffic — starting
+        # up unproxied after a plugin failure would deanonymize them.
+        from .core.plugins import start_proxyconfig
+        if not start_proxyconfig(settings):
+            logging.error(
+                "proxy configuration %r failed; refusing to start "
+                "unproxied", settings.get("sockstype"))
+            node.db.close()
+            return 1
+    if settings.get("sockstype") in ("SOCKS5", "SOCKS4a"):
         node.ctx.proxy = {
             "type": settings.get("sockstype"),
             "host": settings.get("sockshostname"),
@@ -155,6 +167,17 @@ async def run(args) -> int:
         except Exception as exc:
             logging.warning("UPnP port mapping unavailable: %r", exc)
             upnp_client = None
+
+    if settings.getbool("notifysound"):
+        # new-message sound through the notification.sound plugin group
+        # (reference sound_* plugins driven from the UISignal stream)
+        from .core.plugins import get_plugin
+        sound = get_plugin("notification.sound")
+        if sound is not None:
+            soundfile = settings.get("notifysoundfile", "")
+            node.ui.subscribe(
+                lambda cmd, data: sound(soundfile)
+                if cmd == "displayNewInboxMessage" else None)
 
     notifier = None
     if settings.get("apinotifypath"):

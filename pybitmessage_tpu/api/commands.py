@@ -148,6 +148,10 @@ class CommandHandler:
             timeout = min(float(timeout), 60.0)
         except (TypeError, ValueError):
             raise APIError(0, "since/timeout must be numeric")
+        # a cursor ahead of our seq means the daemon restarted (seq
+        # reset to 0) — clamp so the client resynchronizes instead of
+        # waiting for the counter to catch its stale cursor up
+        since = min(since, self.node.ui.seq)
         events = await self.node.ui.wait_for_events(since, timeout)
         out = [{"seq": s, "command": c,
                 "data": [x.hex() if isinstance(x, (bytes, bytearray))

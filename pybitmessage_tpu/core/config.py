@@ -51,7 +51,8 @@ DEFAULTS: dict[str, str] = {
     "udp": "true",                   # LAN discovery
     "upnp": "false",
     "tls": "true",
-    "sockstype": "none",             # none | SOCKS5 | SOCKS4a
+    "sockstype": "none",             # none | SOCKS5 | SOCKS4a | plugin
+                                     # name (e.g. "stem" = private Tor)
     "sockshostname": "",
     "socksport": "9050",
     "socksusername": "",
@@ -75,6 +76,8 @@ DEFAULTS: dict[str, str] = {
     # helper_startup sanity cap: ridiculousDifficulty x network default)
     "maxacceptablenoncetrialsperbyte": "20000000000",
     "maxacceptablepayloadlengthextrabytes": "20000000000",
+    "notifysound": "false",          # ring/play on new inbox message
+    "notifysoundfile": "",           # optional file for the sound plugin
     "minimizeonclose": "false",
     "replybelow": "false",
     "timeformat": "%c",
@@ -110,13 +113,16 @@ VALIDATORS: dict[str, Callable[[str], bool]] = {
     "powlanes": _validate_int_range(128, 1 << 24),
     "powchunks": _validate_int_range(1, 4096),
     "apienabled": _validate_bool,
+    "notifysound": _validate_bool,
     "smtpdenabled": _validate_bool,
     "udp": _validate_bool,
     "upnp": _validate_bool,
     "tls": _validate_bool,
     "apivariant": lambda v: v in ("json", "xml"),
     "inventorystorage": lambda v: v in ("sqlite", "filesystem"),
-    "sockstype": lambda v: v in ("none", "SOCKS5", "SOCKS4a"),
+    # besides the literal protocols, any identifier names a proxyconfig
+    # plugin (reference socksproxytype convention, e.g. "stem")
+    "sockstype": lambda v: v.replace("_", "").isalnum() or v == "none",
     "blackwhitelist": lambda v: v in ("black", "white"),
 }
 

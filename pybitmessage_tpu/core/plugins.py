@@ -26,17 +26,28 @@ KNOWN_GROUPS = (
 
 
 def iter_plugins(group: str):
-    """Yield (name, loaded object) for every plugin in a group."""
+    """Yield (name, loaded object) for every plugin in a group:
+    entry-point-registered packages first, then the shipped builtins
+    (..plugins.BUILTIN — available even from a bare checkout where no
+    dist metadata exists)."""
+    seen = set()
     try:
         eps = entry_points().select(group=f"{GROUP_PREFIX}.{group}")
     except Exception:
-        return
+        eps = ()
     for ep in eps:
         try:
-            yield ep.name, ep.load()
+            obj = ep.load()
         except Exception:
             logger.warning("plugin %s.%s failed to load",
                            group, ep.name, exc_info=True)
+            continue
+        seen.add(ep.name)
+        yield ep.name, obj
+    from ..plugins import iter_builtin
+    for name, obj in iter_builtin(group):
+        if name not in seen:
+            yield name, obj
 
 
 def get_plugin(group: str, name: str | None = None):
@@ -49,20 +60,22 @@ def get_plugin(group: str, name: str | None = None):
 
 
 def start_proxyconfig(settings) -> bool:
-    """Run the configured proxyconfig plugin (reference
-    helper_startup.start_proxyconfig — e.g. proxyconfig_stem launches a
-    private Tor and rewrites the socks settings).  Returns True when a
-    plugin ran successfully."""
-    ptype = settings.get("sockproxytype", "")
-    if not ptype:
+    """Run the configured proxyconfig plugin and return True when one
+    ran successfully (reference helper_startup.start_proxyconfig).
+
+    The reference overloads ``socksproxytype``: values other than the
+    literal protocols name a proxyconfig plugin ('stem' launches a
+    private Tor and rewrites the socks settings).  Our ``sockstype``
+    key follows the same convention."""
+    ptype = settings.get("sockstype", "")
+    if not ptype or ptype in ("none", "SOCKS5", "SOCKS4a"):
         return False
     plugin = get_plugin("proxyconfig", ptype)
     if plugin is None:
         logger.warning("no proxyconfig plugin named %r", ptype)
         return False
     try:
-        plugin(settings)
-        return True
+        return bool(plugin(settings))
     except Exception:
         logger.exception("proxyconfig plugin %r failed", ptype)
         return False

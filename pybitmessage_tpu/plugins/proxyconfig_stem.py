@@ -1,0 +1,124 @@
+"""Tor proxy auto-configuration (analog of the reference's
+``plugins/proxyconfig_stem.py:1-157``).
+
+The reference uses the ``stem`` library to launch a private Tor and
+optionally publish an ephemeral hidden service.  stem is not a
+dependency here; this analog covers the same decision tree with the
+standard library only:
+
+- a REMOTE ``sockshostname`` is respected: just force SOCKS5 on;
+- something already listening on ``socksport`` locally (a system Tor)
+  is adopted as the proxy;
+- otherwise, when a ``tor`` binary is on PATH, a private instance is
+  launched with its own DataDirectory and adopted once bootstrapped.
+
+In every successful case the session settings are rewritten so the
+connection pool dials through SOCKS5 at the configured endpoint
+(remote DNS — hostname CONNECTs — is the default in network/socks.py,
+so no lookups leak around Tor).
+"""
+
+from __future__ import annotations
+
+import atexit
+import logging
+import shutil
+import socket
+import subprocess
+import tempfile
+import threading
+import time
+
+logger = logging.getLogger("pybitmessage_tpu.plugins.stem")
+
+#: private Tor child, kept for teardown
+_tor_process: subprocess.Popen | None = None
+
+BOOTSTRAP_TIMEOUT = 90.0
+
+
+def _port_listening(host: str, port: int) -> bool:
+    try:
+        with socket.create_connection((host, port), timeout=2):
+            return True
+    except OSError:
+        return False
+
+
+def _stop_tor() -> None:
+    global _tor_process
+    if _tor_process is not None and _tor_process.poll() is None:
+        _tor_process.terminate()
+        try:
+            _tor_process.wait(10)
+        except subprocess.TimeoutExpired:
+            _tor_process.kill()
+    _tor_process = None
+
+
+def _launch_private_tor(port: int) -> bool:
+    """Start ``tor --SocksPort port`` and wait for bootstrap.
+
+    A daemon thread drains tor's stdout for the child's whole lifetime
+    (a full pipe would block tor's log writes and wedge the proxy) and
+    flags the bootstrap line; the deadline is enforced on an Event, not
+    on a blocking readline."""
+    global _tor_process
+    tor = shutil.which("tor")
+    if tor is None:
+        return False
+    datadir = tempfile.mkdtemp(prefix="bmtor-")
+    try:
+        _tor_process = subprocess.Popen(
+            [tor, "--SocksPort", str(port), "--DataDirectory", datadir,
+             "--Log", "notice stdout"],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+    except OSError:
+        return False
+    atexit.register(_stop_tor)
+    proc = _tor_process
+    bootstrapped = threading.Event()
+
+    def drain() -> None:
+        assert proc.stdout is not None
+        for line in proc.stdout:
+            logger.debug("(tor) %s", line.rstrip())
+            if "Bootstrapped 100%" in line:
+                bootstrapped.set()
+
+    threading.Thread(target=drain, daemon=True,
+                     name="bmtor-log-drain").start()
+    if bootstrapped.wait(BOOTSTRAP_TIMEOUT):
+        logger.info("private tor bootstrapped on port %d", port)
+        return True
+    if proc.poll() is not None:
+        logger.warning("private tor exited during bootstrap")
+    else:
+        logger.warning("private tor bootstrap timed out")
+    _stop_tor()
+    return False
+
+
+def connect_plugin(settings) -> bool:
+    """Configure (or launch) a Tor SOCKS5 proxy per the settings —
+    mirrors the reference connect_plugin's decision tree."""
+    host = settings.get("sockshostname", "")
+    if host not in ("", "localhost", "127.0.0.1"):
+        # remote proxy chosen for outbound connections: nothing to
+        # launch, but the dial path must treat it as SOCKS5
+        settings.set_temp("sockstype", "SOCKS5")
+        logger.info("remote sockshostname set; using it as SOCKS5 proxy")
+        return True
+    port = settings.getint("socksport") or 9050
+    if not _port_listening("127.0.0.1", port):
+        if not _launch_private_tor(port):
+            logger.warning(
+                "no SOCKS proxy on 127.0.0.1:%d and no tor binary to "
+                "launch one; leaving proxy settings untouched", port)
+            return False
+    else:
+        logger.info("adopting already-running SOCKS proxy on port %d", port)
+    settings.set_temp("sockshostname", "127.0.0.1")
+    settings.set_temp("socksport", port)
+    settings.set_temp("sockstype", "SOCKS5")
+    return True

@@ -71,10 +71,12 @@ def run(rpc: RPCClient) -> int:  # pragma: no cover - needs a tty
     pump = EventPump(rpc).start()
 
     def loop(stdscr):
+        import time as _time
         curses.curs_set(0)
         stdscr.timeout(250)
         pane_i, selected = 0, 0
         message_index = None
+        last_refresh = _time.monotonic()
         status_line = "r refresh  n new  b broadcast  a address  " \
             "+ add  x del  m mode  t trash  Enter read  Tab pane  q quit"
         while True:
@@ -90,7 +92,10 @@ def run(rpc: RPCClient) -> int:  # pragma: no cover - needs a tty
             stdscr.refresh()
             key = stdscr.getch()
             if key == -1:               # getch timeout tick
-                if pump.pending():
+                # pump events drive refresh; a 30 s safety sweep covers
+                # a dropped long-poll or daemon restart
+                if pump.pending() or _time.monotonic() - last_refresh > 30:
+                    last_refresh = _time.monotonic()
                     try:
                         vm.refresh()
                     except CommandError as exc:

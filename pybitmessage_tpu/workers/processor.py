@@ -371,7 +371,7 @@ class ObjectProcessor:
                    + "   Message ostensibly from " + from_address
                    + ":\n\n" + body)
         ack = self.sender.queue_broadcast(
-            ident.address, subject, message,
+            ident.address, subject, message, stream=ident.stream,
             toaddress="[Broadcast subscribers]")
         self.ui_signal("displayNewSentMessage",
                        ("[Broadcast subscribers]", "[Broadcast subscribers]",

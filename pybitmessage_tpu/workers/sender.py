@@ -402,7 +402,7 @@ class SendWorker:
 
     def queue_broadcast(self, fromaddress: str, subject: str,
                         message: str, *, ttl: int = 4 * 24 * 3600,
-                        encoding: int = 2,
+                        encoding: int = 2, stream: int = 1,
                         toaddress: str = "[Broadcast]") -> bytes:
         """Enqueue a broadcast row and nudge the worker; the single
         owner of the queued-broadcast contract (helper_sent.insert with
@@ -410,7 +410,7 @@ class SendWorker:
         mailing-list rebroadcast path alike."""
         import os
         from ..models.payloads import gen_ack_payload
-        ack = gen_ack_payload(1, 0)
+        ack = gen_ack_payload(stream, 0)
         self.store.queue_sent(
             msgid=os.urandom(16), toaddress=toaddress, toripe=b"",
             fromaddress=fromaddress, subject=subject, message=message,

@@ -189,6 +189,11 @@ def test_wait_for_events_long_poll(api_env):
         assert [e["command"] for e in p2["events"]] == ["updateStatusBar"]
         _, resp = await client.call("waitForEvents", p2["next"], 0)
         assert json.loads(resp["result"])["events"] == []
+
+        # a cursor from before a daemon restart (ahead of the fresh
+        # seq counter) is clamped so the client resyncs immediately
+        _, resp = await client.call("waitForEvents", 10**6, 0)
+        assert json.loads(resp["result"])["next"] == node.ui.seq
     run_api_test(api_env, body)
 
 

@@ -1,0 +1,153 @@
+"""Shipped plugins (reference src/plugins/ role): loading through the
+core plugin hook, the stem-analog proxyconfig decision tree, the QR
+encoder's math, sound and autostart plugins.
+"""
+
+import socket
+import threading
+
+import pytest
+
+from pybitmessage_tpu.core.config import Settings
+from pybitmessage_tpu.core.plugins import (
+    get_plugin, iter_plugins, start_proxyconfig,
+)
+from pybitmessage_tpu.utils import qr
+
+
+# -- loading -----------------------------------------------------------------
+
+def test_builtin_plugins_load_through_core_hook():
+    """Every shipped plugin is reachable via core.plugins even from an
+    uninstalled checkout (no entry-point metadata)."""
+    assert get_plugin("proxyconfig", "stem") is not None
+    assert get_plugin("notification.sound", "bell") is not None
+    assert get_plugin("gui.menu", "qrcode") is not None
+    assert get_plugin("desktop", "autostart") is not None
+    assert dict(iter_plugins("proxyconfig"))   # non-empty iteration
+
+
+# -- proxyconfig (stem analog) ----------------------------------------------
+
+def test_proxyconfig_remote_host_respected():
+    s = Settings()
+    s.set_temp("sockstype", "stem")
+    s.set_temp("sockshostname", "tor.example.net")
+    assert start_proxyconfig(s) is True
+    assert s.get("sockstype") == "SOCKS5"
+    assert s.get("sockshostname") == "tor.example.net"
+
+
+def test_proxyconfig_adopts_listening_proxy():
+    """Something already listening on socksport (a system Tor) is
+    adopted: settings rewritten to SOCKS5 at that endpoint — the
+    'plugin configures the proxy endpoint' done criterion."""
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    port = srv.getsockname()[1]
+    accepted = threading.Thread(target=lambda: srv.accept(), daemon=True)
+    accepted.start()
+    try:
+        s = Settings()
+        s.set_temp("sockstype", "stem")
+        s.set_temp("socksport", port)
+        assert start_proxyconfig(s) is True
+        assert s.get("sockstype") == "SOCKS5"
+        assert s.get("sockshostname") == "127.0.0.1"
+        assert s.getint("socksport") == port
+    finally:
+        srv.close()
+
+
+def test_proxyconfig_no_proxy_no_tor_fails_closed(monkeypatch):
+    """Nothing listening and no tor binary: report failure, leave the
+    proxy settings untouched (don't dial unproxied thinking we're
+    torified)."""
+    import pybitmessage_tpu.plugins.proxyconfig_stem as stem
+    monkeypatch.setattr(stem.shutil, "which", lambda name: None)
+    s = Settings()
+    s.set_temp("sockstype", "stem")
+    s.set_temp("socksport", 1)        # nothing listens on port 1
+    assert start_proxyconfig(s) is False
+    assert s.get("sockstype") == "stem"
+    assert s.get("sockshostname") == ""
+
+
+def test_unknown_proxyconfig_plugin():
+    s = Settings()
+    s.set_temp("sockstype", "nonexistent")
+    assert start_proxyconfig(s) is False
+
+
+# -- QR encoder --------------------------------------------------------------
+
+def test_qr_format_and_version_constants():
+    """BCH outputs against the published ISO 18004 examples."""
+    assert qr.format_bits(0) == 0b111011111000100      # level L, mask 0
+    assert qr.version_bits(7) == 0b000111110010010100
+
+
+def test_qr_reed_solomon_syndromes_vanish():
+    data = list(b"BM-2cWY4iD1NKQRu3vQ5NcSpCnxTJTu9R9TYs")
+    for n_ecc in (7, 10, 18, 30):
+        ecc = qr.rs_encode(data, n_ecc)
+        assert all(s == 0 for s in qr.rs_syndromes(data + ecc, n_ecc))
+
+
+def test_qr_structure():
+    m = qr.encode("bitmessage:BM-2cWY4iD1NKQRu3vQ5NcSpCnxTJTu9R9TYs")
+    n = len(m)
+    assert (n - 17) % 4 == 0 and all(len(row) == n for row in m)
+    # finder pattern cores and separators
+    for r0, c0 in ((0, 0), (0, n - 7), (n - 7, 0)):
+        assert m[r0][c0] and m[r0 + 3][c0 + 3] and m[r0 + 6][c0 + 6]
+        assert not m[r0 + 1][c0 + 1]
+    assert not m[7][7]                       # separator corner
+    assert m[n - 8][8]                       # dark module
+    for i in range(8, n - 8):                # timing pattern
+        assert m[6][i] == (i % 2 == 0)
+        assert m[i][6] == (i % 2 == 0)
+
+
+def test_qr_version_scaling_and_overflow():
+    assert len(qr.encode("x")) == 21                     # v1
+    assert len(qr.encode("x" * 100)) > 25                # auto-upscale
+    assert len(qr.encode("x" * 271)) == 57               # v10 maximum
+    with pytest.raises(ValueError):
+        qr.encode("x" * 272)
+
+
+def test_qr_renderings():
+    m = qr.encode("bitmessage:BM-test")
+    text = qr.render_text(m)
+    assert len(text.splitlines()) >= len(m) // 2
+    svg = qr.render_svg(m)
+    assert svg.startswith("<svg") and "<rect" in svg
+
+
+def test_qrcode_plugin_output():
+    plugin = get_plugin("gui.menu", "qrcode")
+    out = plugin("BM-2cWY4iD1NKQRu3vQ5NcSpCnxTJTu9R9TYs")
+    assert out["uri"].startswith("bitmessage:BM-")
+    assert "█" in out["text"] or "▀" in out["text"]
+    assert out["svg"].startswith("<svg")
+
+
+# -- sound + autostart -------------------------------------------------------
+
+def test_sound_bell_plugin_rings(capsys):
+    plugin = get_plugin("notification.sound", "bell")
+    assert plugin("") is True
+    assert "\a" in capsys.readouterr().out
+
+
+def test_autostart_plugin_roundtrip(tmp_path, monkeypatch):
+    monkeypatch.setenv("XDG_CONFIG_HOME", str(tmp_path))
+    plugin = get_plugin("desktop", "autostart")
+    assert plugin(True) is True
+    entry = tmp_path / "autostart" / "pybitmessage-tpu.desktop"
+    assert entry.exists()
+    assert "pybitmessage_tpu" in entry.read_text()
+    assert plugin(False) is True
+    assert not entry.exists()

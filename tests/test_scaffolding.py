@@ -162,13 +162,21 @@ async def test_namecoin_lookup_resolves_bm_address():
 
 # -- plugins -----------------------------------------------------------------
 
-def test_plugin_registry_empty_but_queryable():
+def test_plugin_registry_queryable_and_shipped_groups_populated():
     from pybitmessage_tpu.core.plugins import (
         KNOWN_GROUPS, get_plugin, iter_plugins)
 
+    # every declared group is queryable without error; the groups we
+    # ship builtins for (r3 VERDICT #7) actually yield plugins
+    shipped = {"proxyconfig", "notification.sound", "gui.menu", "desktop"}
     for group in KNOWN_GROUPS:
-        assert list(iter_plugins(group)) == []
-        assert get_plugin(group) is None
+        plugins = dict(iter_plugins(group))
+        if group in shipped:
+            assert plugins, f"no plugin loaded for shipped group {group}"
+            assert get_plugin(group) is not None
+        else:
+            assert plugins == {}
+            assert get_plugin(group) is None
 
 
 def test_populate_test_data_idempotent():

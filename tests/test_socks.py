@@ -17,10 +17,16 @@ class FakeSocks5:
     """Minimal RFC 1928/1929 server that then tunnels to a target."""
 
     def __init__(self, *, require_auth=False, user=b"u", pwd=b"p",
-                 reject_code=0):
+                 reject_code=0, tunnel_to=None, resolve_map=None):
         self.require_auth = require_auth
         self.user, self.pwd = user, pwd
         self.reject_code = reject_code
+        #: override the tunnel target (for .onion hosts the proxy
+        #: "resolves" internally — Tor semantics)
+        self.tunnel_to = tunnel_to
+        #: hostname -> IPv4 string served for RESOLVE (0xF0) requests
+        self.resolve_map = resolve_map or {}
+        self.resolved = None
         self.connected_to = None
         self.server = None
 
@@ -58,6 +64,19 @@ class FakeSocks5:
                 ln = (await reader.readexactly(1))[0]
                 host = (await reader.readexactly(ln)).decode()
             port = struct.unpack(">H", await reader.readexactly(2))[0]
+            if cmd == 0xF0:              # Tor RESOLVE extension
+                self.resolved = host
+                ip = self.resolve_map.get(host)
+                if ip is None:
+                    writer.write(b"\x05\x04\x00\x01" + b"\x00" * 6)
+                else:
+                    import ipaddress
+                    writer.write(b"\x05\x00\x00\x01"
+                                 + ipaddress.IPv4Address(ip).packed
+                                 + b"\x00\x00")
+                await writer.drain()
+                writer.close()
+                return
             self.connected_to = (host, port)
             if self.reject_code:
                 writer.write(b"\x05" + bytes([self.reject_code])
@@ -68,7 +87,8 @@ class FakeSocks5:
             writer.write(b"\x05\x00\x00\x01" + b"\x00" * 6)
             await writer.drain()
             # tunnel both directions
-            tr, tw = await asyncio.open_connection(host, port)
+            tr, tw = await asyncio.open_connection(
+                *(self.tunnel_to or (host, port)))
 
             async def pump(src, dst):
                 try:
@@ -207,4 +227,79 @@ async def test_node_dials_through_socks5_proxy():
     finally:
         await node_b.stop()
         await node_a.stop()
+        await proxy.stop()
+
+
+@pytest.mark.asyncio
+async def test_onion_hostname_passes_through_unresolved():
+    """An .onion peer is CONNECTed by hostname — the proxy (Tor) sees
+    the name; no local resolution is attempted (it would fail: onions
+    have no DNS).  VERDICT r3 'done' criterion for the Tor story."""
+    async def noop(r, w):
+        w.close()
+    target = await asyncio.start_server(noop, "127.0.0.1", 0)
+    tport = target.sockets[0].getsockname()[1]
+    proxy = FakeSocks5(tunnel_to=("127.0.0.1", tport))
+    pport = await proxy.start()
+    try:
+        r, w = await open_via_proxy(
+            "SOCKS5", "127.0.0.1", pport,
+            "quintessential22.onion", 8444)
+        assert proxy.connected_to == ("quintessential22.onion", 8444)
+        w.close()
+    finally:
+        await proxy.stop()
+        target.close()
+
+
+@pytest.mark.asyncio
+async def test_node_dials_onion_peer_by_hostname():
+    """Full stack: the pool dials an .onion knownnode through the
+    proxy; the fake Tor sees the hostname and tunnels to the real
+    listener."""
+    node_a = Node(listen=True, solver=lambda *a, **k: (0, 0),
+                  test_mode=True, allow_private_peers=True,
+                  dandelion_enabled=False, tls_enabled=False)
+    node_b = Node(listen=False, solver=lambda *a, **k: (0, 0),
+                  test_mode=True, allow_private_peers=True,
+                  dandelion_enabled=False, tls_enabled=False)
+    await node_a.start()
+    proxy = FakeSocks5(
+        tunnel_to=("127.0.0.1", node_a.pool.listen_port))
+    pport = await proxy.start()
+    await node_b.start()
+    node_b.ctx.proxy = {"type": "SOCKS5", "host": "127.0.0.1",
+                        "port": pport}
+    try:
+        conn = await node_b.pool.connect_to(
+            Peer("quintessential22.onion", 8444))
+        assert conn is not None
+        for _ in range(100):
+            if conn.fully_established:
+                break
+            await asyncio.sleep(0.05)
+        assert conn.fully_established
+        assert proxy.connected_to == ("quintessential22.onion", 8444)
+    finally:
+        await node_b.stop()
+        await node_a.stop()
+        await proxy.stop()
+
+
+@pytest.mark.asyncio
+async def test_socks5_remote_dns_resolve():
+    """The Tor RESOLVE (0xF0) extension: hostname resolved THROUGH the
+    proxy, nothing touches local DNS (Socks5Resolver analog)."""
+    from pybitmessage_tpu.network.socks import resolve_via_proxy
+
+    proxy = FakeSocks5(resolve_map={"bootstrap.example.net": "10.11.12.13"})
+    pport = await proxy.start()
+    try:
+        addr = await resolve_via_proxy(
+            "127.0.0.1", pport, "bootstrap.example.net")
+        assert addr == "10.11.12.13"
+        assert proxy.resolved == "bootstrap.example.net"
+        with pytest.raises(SocksError, match="resolve failed"):
+            await resolve_via_proxy("127.0.0.1", pport, "unknown.example")
+    finally:
         await proxy.stop()
