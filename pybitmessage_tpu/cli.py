@@ -98,6 +98,110 @@ def _h_send(rpc, argv):
     print(f"queued; ackdata = {ack}")
 
 
+# -- attachments (reference bitmessagecli.py base64 attachment flow) ---------
+
+#: reference reads files up to 180 MB, "the maximum size for Bitmessage"
+MAX_ATTACHMENT = 180 * 1024 * 1024
+
+
+def encode_attachment(path: str) -> str:
+    """Wrap a file in the reference's inline-attachment markup
+    (bitmessagecli.py attachment(): Filename/Filesize header + an
+    ``<attachment alt=... src='data:file/...;base64, ...' />`` tag) so
+    reference clients extract it unchanged."""
+    import os
+    name = os.path.basename(path)
+    try:
+        with open(path, "rb") as f:
+            data = f.read(MAX_ATTACHMENT + 1)
+    except OSError as exc:
+        raise CommandError(f"cannot read attachment: {exc}")
+    if len(data) > MAX_ATTACHMENT:
+        raise CommandError("attachment exceeds the 180MB protocol cap")
+    b64 = base64.b64encode(data).decode("ascii")
+    size_kb = round(len(data) / 1024.0, 2)
+    return (
+        "\n<!-- Note: File attachment below. Please use a base64 "
+        "decoder, or Daemon, to save it. -->\n\n"
+        f"Filename:{name}\n"
+        f"Filesize:{size_kb}KB\n"
+        "Encoding:base64\n\n"
+        f"<attachment alt = \"{name}\" "
+        f"src='data:file/{name};base64, {b64}' />")
+
+
+def extract_attachments(message: str) -> tuple[list[tuple[str, bytes]],
+                                               str]:
+    """(attachments, cleaned_message) — the reference's detection loop
+    (bitmessagecli.py:1012-1038): each ``;base64,``...``' />`` span is
+    decoded and replaced by a placeholder in the display text."""
+    out: list[tuple[str, bytes]] = []
+    while True:
+        att_pos = message.find(";base64,")
+        att_end = message.find("' />")
+        if att_pos < 0 or att_end < att_pos:
+            break
+        # the filename must come from the SAME tag: search only the
+        # text before the data span (an alt=... appearing after it is
+        # attacker-placed noise; honoring it would leave the span in
+        # the string and loop forever)
+        prefix = message[:att_pos]
+        fn_pos = prefix.rfind('alt = "')
+        fn_end = prefix.find('" src=', fn_pos) if fn_pos >= 0 else -1
+        if fn_pos >= 0 and fn_end > fn_pos:
+            name = prefix[fn_pos + 7:fn_end]
+            cut_from = fn_pos
+        else:
+            name = "Attachment"
+            cut_from = att_pos
+        try:
+            data = base64.b64decode(message[att_pos + 9:att_end],
+                                    validate=False)
+        except Exception:
+            data = b""
+        out.append((name, data))
+        message = (message[:cut_from]
+                   + "~<Attachment data removed for easier viewing>~"
+                   + message[att_end + 4:])
+    return out, message
+
+
+def _h_sendfile(rpc, argv):
+    to, sender, subject, path = argv[:4]
+    body = " ".join(argv[4:])
+    message = body + "\n\n" + encode_attachment(path) if body \
+        else encode_attachment(path)
+    ack = rpc.call("sendMessage", to, sender, _b64(subject),
+                   _b64(message))
+    print(f"queued with attachment; ackdata = {ack}")
+
+
+def _h_saveattachment(rpc, argv):
+    import os
+    msgid = argv[0]
+    directory = argv[1] if len(argv) > 1 else "."
+    out = json.loads(rpc.call("getInboxMessageById", msgid, True))
+    saved = 0
+    for m in out["inboxMessage"]:
+        attachments, _ = extract_attachments(_unb64(m["message"]))
+        for name, data in attachments:
+            # sender-controlled filename: basename only, never empty —
+            # no path traversal out of the target directory
+            safe = os.path.basename(name.replace("\\", "/")) or "attachment"
+            target = os.path.join(directory, safe)
+            base, ext = os.path.splitext(target)
+            n = 1
+            while os.path.exists(target):
+                target = f"{base}.{n}{ext}"
+                n += 1
+            with open(target, "wb") as f:
+                f.write(data)
+            print(f"saved {target} ({len(data)} bytes)")
+            saved += 1
+    if not saved:
+        print("(no attachments found)")
+
+
 def _h_broadcast(rpc, argv):
     sender, subject, body = argv[:3]
     ack = rpc.call("sendBroadcast", sender, _b64(subject), _b64(body))
@@ -146,6 +250,7 @@ def _h_read(rpc, argv):
     out = json.loads(rpc.call("getInboxMessageById", argv[0], True))
     for m in out["inboxMessage"]:
         raw = _unb64(m["message"])
+        attachments, raw = extract_attachments(raw)
         print(f"From:    {m['fromAddress']}")
         print(f"To:      {m['toAddress']}")
         print(f"Subject: {sanitize_line(_unb64(m['subject']))}")
@@ -153,6 +258,10 @@ def _h_read(rpc, argv):
         # untrusted body: markup/escape-sequence stripped, link targets
         # listed visibly (utils/safetext.py, safehtmlparser role)
         print(sanitize(raw))
+        for name, data in attachments:
+            print(f"[attachment: {sanitize_line(name)} "
+                  f"({len(data)} bytes) — 'saveattachment <msgid> [dir]'"
+                  " to extract]")
         links = extract_links(raw)
         if links:
             print()
@@ -224,6 +333,8 @@ COMMANDS: dict[str, tuple[str, int, callable]] = {
     "createdeterministic": ("<passphrase>", 1, _h_createdeterministic),
     "deleteaddress": ("<address>", 1, _h_deleteaddress),
     "send": ("<to> <from> <subject> <body>", 4, _h_send),
+    "sendfile": ("<to> <from> <subject> <file> [body]", 4, _h_sendfile),
+    "saveattachment": ("<msgid> [dir]", 1, _h_saveattachment),
     "broadcast": ("<from> <subject> <body>", 3, _h_broadcast),
     "inbox": ("", 0, _h_inbox),
     "search": ("<text>", 1, _h_search),

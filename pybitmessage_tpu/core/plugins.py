@@ -75,7 +75,9 @@ def start_proxyconfig(settings) -> bool:
         logger.warning("no proxyconfig plugin named %r", ptype)
         return False
     try:
-        return bool(plugin(settings))
+        # reference-convention plugins return None on success — only an
+        # explicit False (or an exception) means the proxy is NOT up
+        return plugin(settings) is not False
     except Exception:
         logger.exception("proxyconfig plugin %r failed", ptype)
         return False

@@ -171,6 +171,67 @@ class GUIController:
         self.view.set_status(tr("now in {mode}list mode", mode=mode))
         return self.refresh()
 
+    # -- subscriptions / chans / identity extras -----------------------------
+
+    def subscribe_add(self, address: str, label: str) -> bool:
+        try:
+            self.vm.subscribe_add(address, label)
+        except CommandError as exc:
+            self.view.show_error(tr("add failed"), str(exc))
+            return False
+        return self.refresh()
+
+    def subscribe_delete(self, index: int) -> bool:
+        if index < 0:
+            return False
+        try:
+            self.vm.subscribe_delete(index)
+        except CommandError as exc:
+            self.view.set_status(f"error: {exc}")
+            return False
+        return self.refresh()
+
+    def chan_create(self, passphrase: str | None) -> bool:
+        if not passphrase:
+            return False
+        try:
+            addr = self.vm.chan_create(passphrase)
+        except CommandError as exc:
+            self.view.show_error(tr("chan failed"), str(exc))
+            return False
+        self.view.set_status(tr("chan created: {addr}", addr=addr))
+        return self.refresh()
+
+    def chan_join(self, passphrase: str, address: str) -> bool:
+        try:
+            self.vm.chan_join(passphrase, address)
+        except CommandError as exc:
+            self.view.show_error(tr("chan failed"), str(exc))
+            return False
+        return self.refresh()
+
+    def chan_leave(self, index: int) -> bool:
+        try:
+            self.vm.chan_leave(index)
+        except CommandError as exc:
+            self.view.set_status(f"error: {exc}")
+            return False
+        return self.refresh()
+
+    def toggle_mailing_list(self, index: int, name: str = "") -> bool:
+        try:
+            enabled = self.vm.toggle_mailing_list(index, name)
+        except (CommandError, IndexError) as exc:
+            self.view.set_status(f"error: {exc}")
+            return False
+        self.view.set_status(tr("mailing list enabled")
+                             if enabled else tr("mailing list disabled"))
+        return self.refresh()
+
+    def qr_text(self, index: int) -> str:
+        """Text QR for the identity at ``index`` (qrcode plugin)."""
+        return "\n".join(self.vm.qr_for(index))
+
     # -- settings ------------------------------------------------------------
 
     def load_settings(self) -> dict[str, str] | None:
@@ -254,6 +315,8 @@ class BMApp:  # pragma: no cover - thin widget shell; logic is GUIController
                 (tr("Trash selected"), self._trash),
                 (tr("Add entry"), self._add_entry),
                 (tr("Remove entry"), self._remove_entry),
+                (tr("Chan..."), self._chan_dialog),
+                (tr("QR"), self._show_qr),
                 (tr("Toggle mode"), self.ctl.toggle_list_mode),
                 (tr("Settings"), self._settings_dialog),
                 (tr("Refresh"), self.ctl.refresh)):
@@ -406,6 +469,8 @@ class BMApp:  # pragma: no cover - thin widget shell; logic is GUIController
         pane = self._current_pane()
         if pane == "blacklist":
             self._entry_dialog(tr("Blacklist"), self.ctl.blacklist_add)
+        elif pane == "subscriptions":
+            self._entry_dialog(tr("Subscribe"), self.ctl.subscribe_add)
         else:
             self._entry_dialog(tr("Address book"),
                                self.ctl.addressbook_add)
@@ -415,9 +480,40 @@ class BMApp:  # pragma: no cover - thin widget shell; logic is GUIController
         if pane == "blacklist":
             self.ctl.blacklist_delete(
                 self._selected_index(self.lists["blacklist"]))
+        elif pane == "subscriptions":
+            self.ctl.subscribe_delete(
+                self._selected_index(self.lists["subscriptions"]))
         elif pane == "addressbook":
             self.ctl.addressbook_delete(
                 self._selected_index(self.lists["addressbook"]))
+        elif pane == "identities":
+            # identities pane: removal = leaving a chan
+            self.ctl.chan_leave(
+                self._selected_index(self.lists["identities"]))
+
+    def _chan_dialog(self):
+        from tkinter.simpledialog import askstring
+        passphrase = askstring(tr("Chan"), tr("Passphrase") + ":")
+        if not passphrase:
+            return
+        address = askstring(
+            tr("Chan"), tr("Address (empty to create a new chan)") + ":")
+        if address:
+            self.ctl.chan_join(passphrase, address)
+        else:
+            self.ctl.chan_create(passphrase)
+
+    def _show_qr(self):
+        i = self._selected_index(self.lists["identities"])
+        if i < 0:
+            return
+        win = self.tk.Toplevel(self.root)
+        win.title(tr("QR code"))
+        text = self.tk.Text(win, width=70, height=35,
+                            font=("Courier", 8))
+        text.pack(fill="both", expand=True)
+        text.insert("1.0", self.ctl.qr_text(i))
+        text.configure(state="disabled")
 
     def _settings_dialog(self):
         values = self.ctl.load_settings()

@@ -19,6 +19,23 @@ from typing import Any, Iterable, Sequence
 
 SCHEMA_VERSION = 11
 
+#: the version ``_SCHEMA`` below creates; _SCHEMA is frozen here —
+#: every later schema change goes into MIGRATIONS, which fresh and
+#: existing databases BOTH run (so the two paths cannot diverge)
+BASELINE_VERSION = 11
+
+#: Ordered migration registry: target version -> SQL statements that
+#: bring a (target-1) database to it.  The reference evolves its schema
+#: through 11 in-place upgrade steps (class_sqlThread.py:94-460); this
+#: framework starts AT the v11-equivalent baseline, so 11 is a recorded
+#: no-op — the hook exists so the first post-ship schema change is a
+#: dict entry + SCHEMA_VERSION bump, not a redesign.  The current
+#: version lives in ``PRAGMA user_version`` (mirrored to the settings
+#: table for reference-parity introspection).
+MIGRATIONS: dict[int, tuple[str, ...]] = {
+    BASELINE_VERSION: (),   # baseline: reference-v11-equivalent schema
+}
+
 _SCHEMA = """
 CREATE TABLE IF NOT EXISTS inbox (
     msgid blob, toaddress text, fromaddress text, subject text,
@@ -61,13 +78,41 @@ class Database:
             if path != ":memory:":
                 cur.execute("PRAGMA journal_mode = WAL")
             cur.execute("PRAGMA secure_delete = true")
+            fresh = not cur.execute(
+                "SELECT 1 FROM sqlite_master WHERE type='table'"
+                " AND name='sent'").fetchone()
             cur.executescript(_SCHEMA)
+            if fresh:
+                # _SCHEMA creates the frozen baseline; the migration
+                # ladder below brings fresh installs to HEAD too, so a
+                # MIGRATIONS entry is the single source of truth
+                cur.execute("PRAGMA user_version = %d" % BASELINE_VERSION)
+            self._migrate(cur)
+            cur.execute("PRAGMA user_version = %d" % SCHEMA_VERSION)
             cur.execute(
-                "INSERT OR IGNORE INTO settings VALUES('version', ?)",
+                "INSERT OR REPLACE INTO settings VALUES('version', ?)",
                 (str(SCHEMA_VERSION),))
             cur.execute(
                 "INSERT OR IGNORE INTO settings VALUES('lastvacuumtime', ?)",
                 (int(time.time()),))
+
+    def _migrate(self, cur) -> None:
+        """Apply MIGRATIONS above the recorded version, in order
+        (reference class_sqlThread.py:94-460 upgrade ladder)."""
+        current = cur.execute("PRAGMA user_version").fetchone()[0]
+        if current == 0:
+            # pre-user_version database: adopt the settings-table
+            # version stamp (always written since round 1)
+            row = cur.execute(
+                "SELECT value FROM settings WHERE key='version'").fetchone()
+            current = int(row[0]) if row else SCHEMA_VERSION
+        for target in sorted(MIGRATIONS):
+            if target <= current:
+                continue
+            for statement in MIGRATIONS[target]:
+                cur.execute(statement)
+            cur.execute("PRAGMA user_version = %d" % target)
+            current = target
 
     # -- generic access ------------------------------------------------------
 

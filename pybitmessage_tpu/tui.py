@@ -30,12 +30,16 @@ from .viewmodel import (  # noqa: F401
 
 
 def render_frame(vm: ViewModel, pane: str, selected: int, width: int,
-                 message_index: int | None = None) -> list[str]:
+                 message_index: int | None = None,
+                 overlay: list[str] | None = None) -> list[str]:
     """Whole-screen render (header + body) as plain lines — the
-    testable composition the curses shell paints."""
+    testable composition the curses shell paints.  ``overlay`` (e.g. a
+    QR code) replaces the pane body until dismissed."""
     tabs = "  ".join(("[%s]" % p) if p == pane else p for p in PANES)
     out = [_clip(tabs, width), "-" * max(width - 1, 1)]
-    if message_index is not None:
+    if overlay is not None:
+        out.extend(_clip(line, width) for line in overlay)
+    elif message_index is not None:
         out.extend(vm.render_message(message_index, width))
     else:
         for i, line in enumerate(vm.render_pane(pane, width)):
@@ -76,15 +80,18 @@ def run(rpc: RPCClient) -> int:  # pragma: no cover - needs a tty
         stdscr.timeout(250)
         pane_i, selected = 0, 0
         message_index = None
+        overlay = None
         last_refresh = _time.monotonic()
         status_line = "r refresh  n new  b broadcast  a address  " \
-            "+ add  x del  m mode  t trash  Enter read  Tab pane  q quit"
+            "+ add  x del  m mode  t trash  Enter read/edit  " \
+            "c chan  C join  Q qr  M list  Tab pane  q quit"
         while True:
             stdscr.erase()
             h, w = stdscr.getmaxyx()
             pane = PANES[pane_i]
             frame = render_frame(vm, pane, selected, w,
-                                 message_index=message_index)
+                                 message_index=message_index,
+                                 overlay=overlay)
             for y, line in enumerate(frame[:h - 1]):
                 stdscr.addstr(y, 0, line)
             stdscr.addstr(h - 1, 0, _clip(status_line, w),
@@ -101,6 +108,9 @@ def run(rpc: RPCClient) -> int:  # pragma: no cover - needs a tty
                     except CommandError as exc:
                         status_line = f"error: {exc}"
                 continue
+            if overlay is not None:     # any key dismisses an overlay
+                overlay = None
+                continue
             if key in (ord("q"), 27) and message_index is None:
                 return 0
             if key in (ord("q"), 27):
@@ -109,12 +119,30 @@ def run(rpc: RPCClient) -> int:  # pragma: no cover - needs a tty
             if key == ord("\t"):
                 pane_i = (pane_i + 1) % len(PANES)
                 selected, message_index = 0, None
+                if PANES[pane_i] == "Settings":
+                    try:
+                        vm.refresh_settings()
+                    except CommandError as exc:
+                        status_line = f"error: {exc}"
             elif key in (curses.KEY_DOWN, ord("j")):
                 selected += 1
             elif key in (curses.KEY_UP, ord("k")):
                 selected = max(0, selected - 1)
             elif key in (10, 13, curses.KEY_ENTER) and pane == "Inbox":
                 message_index = selected
+            elif key in (10, 13, curses.KEY_ENTER) and pane == "Settings":
+                # edit the selected setting (reference bitmessagecurses
+                # settings dialog flow)
+                keys = vm.settings_keys()
+                if 0 <= selected < len(keys):
+                    skey = keys[selected]
+                    try:
+                        value = prompt(stdscr, f"{skey} = ")
+                        if value:
+                            vm.update_setting(skey, value)
+                        vm.refresh_settings()
+                    except CommandError as exc:
+                        status_line = f"error: {exc}"
             elif key == ord("t") and pane == "Inbox":
                 vm.trash_inbox(selected)
                 vm.refresh()
@@ -144,23 +172,63 @@ def run(rpc: RPCClient) -> int:  # pragma: no cover - needs a tty
                     vm.refresh()
                 except CommandError as exc:
                     status_line = f"error: {exc}"
-            elif key == ord("+") and pane in ("Addressbook", "Blacklist"):
+            elif key == ord("+") and pane in ("Addressbook", "Blacklist",
+                                              "Subscriptions"):
                 try:
                     address = prompt(stdscr, "Address: ")
                     label = prompt(stdscr, "Label: ")
                     if pane == "Addressbook":
                         vm.addressbook_add(address, label)
+                    elif pane == "Subscriptions":
+                        vm.subscribe_add(address, label)
                     else:
                         vm.blacklist_add(address, label)
                     vm.refresh()
                 except CommandError as exc:
                     status_line = f"error: {exc}"
-            elif key == ord("x") and pane in ("Addressbook", "Blacklist"):
+            elif key == ord("x") and pane in ("Addressbook", "Blacklist",
+                                              "Subscriptions",
+                                              "Identities"):
                 try:
                     if pane == "Addressbook":
                         vm.addressbook_delete(selected)
+                    elif pane == "Subscriptions":
+                        vm.subscribe_delete(selected)
+                    elif pane == "Identities":
+                        vm.chan_leave(selected)     # chans only
                     else:
                         vm.blacklist_delete(selected - 1)  # row 0 = header
+                    vm.refresh()
+                except CommandError as exc:
+                    status_line = f"error: {exc}"
+            elif key == ord("c") and pane == "Identities":
+                try:
+                    passphrase = prompt(stdscr, "Chan passphrase: ")
+                    addr = vm.chan_create(passphrase)
+                    status_line = f"chan created: {addr}"
+                    vm.refresh()
+                except CommandError as exc:
+                    status_line = f"error: {exc}"
+            elif key == ord("C") and pane == "Identities":
+                try:
+                    passphrase = prompt(stdscr, "Chan passphrase: ")
+                    address = prompt(stdscr, "Chan address: ")
+                    vm.chan_join(passphrase, address)
+                    vm.refresh()
+                except CommandError as exc:
+                    status_line = f"error: {exc}"
+            elif key == ord("Q") and pane == "Identities":
+                overlay = vm.qr_for(selected)
+            elif key == ord("M") and pane == "Identities":
+                try:
+                    row_is_list = (0 <= selected < len(vm.addresses)
+                                   and vm.addresses[selected]
+                                   .get("mailinglist"))
+                    name = "" if row_is_list else \
+                        prompt(stdscr, "List name: ")
+                    enabled = vm.toggle_mailing_list(selected, name)
+                    status_line = "mailing list " + \
+                        ("enabled" if enabled else "disabled")
                     vm.refresh()
                 except CommandError as exc:
                     status_line = f"error: {exc}"

@@ -189,7 +189,12 @@ def encode(data: bytes | str) -> list[list[bool]]:
     centers = _ALIGN[version]
     for r in centers:
         for c in centers:
-            if M[r][c] is not None:                     # overlaps finder
+            # skip only the three finder-corner overlaps; centers on
+            # the timing row/column (v7+: e.g. (6,22)) are REQUIRED and
+            # drawn over the timing pattern per the spec
+            if (r - 2 <= 7 and c - 2 <= 7) \
+                    or (r - 2 <= 7 and c + 2 >= n - 8) \
+                    or (r + 2 >= n - 8 and c - 2 <= 7):
                 continue
             set_square(r - 2, c - 2, 5, True)
             set_square(r - 1, c - 1, 3, False)

@@ -19,7 +19,7 @@ from .utils.identicon import derive, render_compact
 from .utils.safetext import extract_links, sanitize, sanitize_line
 
 PANES = ("Inbox", "Sent", "Identities", "Subscriptions", "Addressbook",
-         "Blacklist", "Network")
+         "Blacklist", "Settings", "Network")
 
 
 class EventPump:
@@ -134,6 +134,7 @@ class ViewModel:
             "Subscriptions": self.render_subscriptions,
             "Addressbook": self.render_addressbook,
             "Blacklist": self.render_blacklist,
+            "Settings": self.render_settings,
         }.get(pane, self.render_network)(width)
 
     def render_inbox(self, width: int) -> list[str]:
@@ -159,7 +160,9 @@ class ViewModel:
                     + ")"]
         return [_clip(
             f"{a['address']:42.42s} [{a['label']}]"
-            + ("  (chan)" if a.get("chan") else ""), width)
+            + ("  (chan)" if a.get("chan") else "")
+            + (f"  (list:{a.get('mailinglistname') or a['label']})"
+               if a.get("mailinglist") else ""), width)
             for a in self.addresses]
 
     def render_subscriptions(self, width: int) -> list[str]:
@@ -191,6 +194,22 @@ class ViewModel:
             f"{'on ' if e.get('enabled') else 'off'} "
             f"{e['address']:42.42s} [{_unb64(e['label'])}]", width)
             for e in rows]
+
+    def render_settings(self, width: int) -> list[str]:
+        """key = value rows, editable from the TUI (reference
+        bitmessagecurses settings dialog flows)."""
+        if not self.settings:
+            try:
+                self.refresh_settings()
+            except CommandError:
+                return ["(" + tr("settings unavailable") + ")"]
+        rows = [(k, v) for k, v in sorted(self.settings.items())
+                if not isinstance(v, (list, dict))]
+        return [_clip(f"{k:32.32s} = {v}", width) for k, v in rows]
+
+    def settings_keys(self) -> list[str]:
+        return [k for k, v in sorted(self.settings.items())
+                if not isinstance(v, (list, dict))]
 
     def render_network(self, width: int) -> list[str]:
         s = self.status
@@ -302,3 +321,50 @@ class ViewModel:
 
     def update_setting(self, key: str, value: str) -> str:
         return self.rpc.call("updateSetting", key, value)
+
+    # -- subscriptions / chans / identity extras -----------------------------
+
+    def subscribe_add(self, address: str, label: str) -> str:
+        return self.rpc.call("addSubscription", address, _b64(label))
+
+    def subscribe_delete(self, index: int) -> None:
+        if 0 <= index < len(self.subscriptions):
+            self.rpc.call("deleteSubscription",
+                          self.subscriptions[index]["address"])
+
+    def chan_create(self, passphrase: str) -> str:
+        """Create a chan; its address derives from the passphrase."""
+        return self.rpc.call("createChan", _b64(passphrase))
+
+    def chan_join(self, passphrase: str, address: str) -> str:
+        return self.rpc.call("joinChan", _b64(passphrase), address)
+
+    def chan_leave(self, index: int) -> str:
+        row = self.addresses[index] if 0 <= index < len(self.addresses) \
+            else None
+        if not row or not row.get("chan"):
+            raise CommandError(tr("selected identity is not a chan"))
+        return self.rpc.call("leaveChan", row["address"])
+
+    def toggle_mailing_list(self, index: int, name: str = "") -> bool:
+        """Flip mailing-list mode on the selected identity; returns the
+        new state."""
+        if not (0 <= index < len(self.addresses)):
+            raise CommandError(tr("no identity selected"))
+        row = self.addresses[index]
+        enable = not row.get("mailinglist")
+        self.rpc.call("setMailingList", row["address"], enable,
+                      _b64(name) if (enable and name) else "")
+        return enable
+
+    def qr_for(self, index: int) -> list[str]:
+        """Text-QR overlay lines for the selected identity (the shipped
+        qrcode plugin, reference menu_qrcode role)."""
+        if not (0 <= index < len(self.addresses)):
+            return ["(no identity selected)"]
+        from .core.plugins import get_plugin
+        plugin = get_plugin("gui.menu", "qrcode")
+        if plugin is None:
+            return ["(qrcode plugin unavailable)"]
+        out = plugin(self.addresses[index]["address"])
+        return [out["uri"], ""] + out["text"].splitlines()

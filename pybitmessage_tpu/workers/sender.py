@@ -34,8 +34,8 @@ from ..models.payloads import (
 )
 from ..models.pow_math import pow_target
 from ..storage.messages import (
-    ACKRECEIVED, AWAITINGPUBKEY, BROADCASTSENT, DOINGMSGPOW, MSGQUEUED,
-    MSGSENT, MSGSENTNOACKEXPECTED, MessageStore,
+    ACKRECEIVED, AWAITINGPUBKEY, BROADCASTSENT, DOINGMSGPOW,
+    DOINGPUBKEYPOW, MSGQUEUED, MSGSENT, MSGSENTNOACKEXPECTED, MessageStore,
 )
 from ..utils.addresses import decode_address
 from ..utils.hashes import inventory_hash, sha512
@@ -124,7 +124,9 @@ class SendWorker:
         """Recover state from the sent table (class_singleWorker.py:72-117)."""
         for m in self.store.sent_by_status(MSGSENT, DOINGMSGPOW):
             self.watched_acks.add(m.ackdata)
-        for m in self.store.sent_by_status(AWAITINGPUBKEY, "doingpubkeypow"):
+        # (doingpubkeypow rows were already re-queued to msgqueued by
+        # reset_interrupted_pow, which runs before this)
+        for m in self.store.sent_by_status(AWAITINGPUBKEY):
             try:
                 a = decode_address(m.toaddress)
             except Exception:
@@ -372,6 +374,10 @@ class SendWorker:
         ttl = _jitter_ttl(int(GETPUBKEY_RETRY / 2.5))
         expires = int(time.time()) + ttl
         payload = assemble_getpubkey(expires, to.version, to.stream, to.ripe)
+        # visible while the getpubkey PoW runs; a crash here is
+        # re-queued by reset_interrupted_pow at next startup
+        # (class_singleWorker.py:874-895 doingpubkeypow stage)
+        self.store.update_sent_status(ackdata, DOINGPUBKEYPOW)
         payload = await self._do_pow(payload, ttl)
         self._publish(payload, 0, to.stream)
         self.store.update_sent_status(

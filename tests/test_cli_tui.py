@@ -156,3 +156,110 @@ async def test_cli_search():
     out = await _run(rpc, "search", ["NEEDLE"])
     assert out.count("\n") == 2  # both messages match, one line each
     assert "(no matches)" in await _run(rpc, "search", ["zzz-nothing"])
+
+
+def test_attachment_markup_roundtrip(tmp_path):
+    """encode_attachment emits the reference's inline markup and
+    extract_attachments recovers the exact bytes (bitmessagecli.py
+    attachment() / detection loop contract)."""
+    from pybitmessage_tpu.cli import encode_attachment, extract_attachments
+
+    payload = bytes(range(256)) * 41
+    f = tmp_path / "report final.bin"
+    f.write_bytes(payload)
+    markup = encode_attachment(str(f))
+    assert "Filename:report final.bin" in markup
+    assert ";base64, " in markup and markup.rstrip().endswith("' />")
+
+    atts, cleaned = extract_attachments("hello\n\n" + markup)
+    assert atts == [("report final.bin", payload)]
+    assert "Attachment data removed" in cleaned
+    assert "hello" in cleaned and ";base64," not in cleaned
+
+    # multiple attachments extract in order
+    two = "x\n" + markup + "\n" + markup
+    atts2, _ = extract_attachments(two)
+    assert len(atts2) == 2
+
+    # garbage base64 degrades to empty bytes, not a crash
+    atts3, _ = extract_attachments(
+        "<attachment alt = \"x\" src='data:file/x;base64, !!!not-b64' />")
+    assert atts3 and atts3[0][1] == b""
+
+
+@pytest.mark.asyncio
+async def test_cli_sendfile_and_saveattachment(tmp_path):
+  async with live_api() as (node, rpc):
+    addr = (await _run(rpc, "createaddress", ["files"])).strip()
+    src = tmp_path / "data.bin"
+    payload = b"\x00\x01binary payload\xff" * 100
+    src.write_bytes(payload)
+
+    await _run(rpc, "sendfile",
+               [addr, addr, "with file", str(src), "see attached"])
+    for _ in range(400):
+        if node.store.inbox():
+            break
+        await asyncio.sleep(0.05)
+    inbox_out = await _run(rpc, "inbox")
+    msgid = inbox_out.split()[1]
+
+    read_out = await _run(rpc, "read", [msgid])
+    assert "[attachment: data.bin" in read_out
+    assert "see attached" in read_out
+    assert ";base64," not in read_out          # blob hidden from display
+
+    outdir = tmp_path / "saved"
+    outdir.mkdir()
+    save_out = await _run(rpc, "saveattachment", [msgid, str(outdir)])
+    assert "saved" in save_out
+    assert (outdir / "data.bin").read_bytes() == payload
+
+    # second save never overwrites: a numbered sibling appears
+    await _run(rpc, "saveattachment", [msgid, str(outdir)])
+    assert (outdir / "data.1.bin").exists()
+
+
+def test_saveattachment_sanitizes_hostile_filename(tmp_path, monkeypatch):
+    """A sender-controlled '../../etc/passwd' style name must not
+    escape the target directory."""
+    import json as _json
+    from pybitmessage_tpu import cli as climod
+
+    hostile = ("<attachment alt = \"../../escape.txt\" "
+               "src='data:file/x;base64, "
+               + base64.b64encode(b"gotcha").decode() + "' />")
+
+    class FakeRPC:
+        def call(self, method, *params):
+            return _json.dumps({"inboxMessage": [{
+                "message": base64.b64encode(
+                    hostile.encode()).decode()}]})
+
+    outdir = tmp_path / "jail"
+    outdir.mkdir()
+    io_buf = io.StringIO()
+    with redirect_stdout(io_buf):
+        climod._h_saveattachment(FakeRPC(), ["mid", str(outdir)])
+    assert (outdir / "escape.txt").read_bytes() == b"gotcha"
+    assert not (tmp_path / "escape.txt").exists()
+
+
+def test_extract_attachments_hostile_trailing_alt_terminates():
+    """Regression: an alt=.../src= pair placed AFTER the data span must
+    not send the extractor into an infinite loop (the filename search
+    is constrained to the text before the span)."""
+    from pybitmessage_tpu.cli import extract_attachments
+    hostile = ("<attachment src='data:file/x;base64, QUFBQQ==' /> "
+               'trailing alt = "name" and a " src= marker')
+    atts, cleaned = extract_attachments(hostile)
+    assert atts == [("Attachment", b"AAAA")]
+    assert ";base64," not in cleaned
+    # and a pre-span alt from an unrelated tag yields the span's OWN
+    # name (rfind picks the nearest alt before the data)
+    two_tags = ('decoy alt = "wrong" src= text '
+                "<attachment alt = \"right.bin\" "
+                "src='data:file/right.bin;base64, QkJC' />")
+    atts2, _ = extract_attachments(two_tags)
+    assert atts2[0][0] == "right.bin"
+    assert atts2[0][1] == b"BBB"

@@ -170,3 +170,83 @@ async def test_identicon_helper_for_canvas():
   async with live_controller() as (node, ctl, view):
     grid, color = ctl.identicon("BM-someaddress")
     assert len(grid) == 7 and color.startswith("#")
+
+
+@pytest.mark.asyncio
+async def test_subscriptions_chans_qr_mailinglist_flows():
+    """The r3-parity controller surface: subscribe/unsubscribe, chan
+    create/join/leave, QR text, mailing-list toggle — all headless."""
+    async with live_controller() as (node, ctl, view):
+        def t(fn, *a):
+            return asyncio.to_thread(fn, *a)
+
+        assert await t(ctl.create_identity, "gui id")
+        target = node.keystore.identities and \
+            list(node.keystore.identities)[0]
+
+        # subscriptions
+        assert await t(ctl.subscribe_add, target, "feed label")
+        assert any(r[0] == target for r in view.lists["subscriptions"])
+        assert await t(ctl.subscribe_delete, 0)
+        assert view.lists["subscriptions"] == []
+
+        # chans: create, then leave via the identities pane removal
+        assert await t(ctl.chan_create, "gui chan phrase")
+        assert any("chan created" in s for s in view.status)
+        chan_rows = [i for i, a in enumerate(ctl.vm.addresses)
+                     if a.get("chan")]
+        assert chan_rows
+        # leaving a non-chan row errors cleanly
+        non_chan = [i for i, a in enumerate(ctl.vm.addresses)
+                    if not a.get("chan")][0]
+        assert not await t(ctl.chan_leave, non_chan)
+        assert await t(ctl.chan_leave, chan_rows[0])
+        assert not any(a.get("chan") for a in ctl.vm.addresses)
+
+        # chan join round-trips through the deterministic address
+        chan_addr = await t(ctl.vm.chan_create, "rejoin phrase")
+        await t(ctl.vm.chan_leave, [i for i, a in
+                enumerate((await t(ctl.vm.refresh)) or ctl.vm.addresses)
+                if a.get("chan")][0])
+        assert await t(ctl.chan_join, "rejoin phrase", chan_addr)
+        assert any(a.get("chan") for a in ctl.vm.addresses)
+
+        # QR text for the first identity
+        qr = await t(ctl.qr_text, 0)
+        assert qr.startswith("bitmessage:BM-")
+        assert "█" in qr or "▀" in qr
+
+        # mailing-list toggle shows up in the rendered identity row
+        assert await t(ctl.toggle_mailing_list, 0, "gui list")
+        assert any("(list:gui list)" in ln
+                   for ln in ctl.vm.render_addresses(120))
+        assert await t(ctl.toggle_mailing_list, 0)
+        assert not any("(list:" in ln
+                       for ln in ctl.vm.render_addresses(120))
+
+
+@pytest.mark.asyncio
+async def test_settings_pane_render_and_overlay_frame():
+    """render_settings rows are editable keys; render_frame paints an
+    overlay instead of the pane body until dismissed."""
+    from pybitmessage_tpu.tui import render_frame
+    async with live_controller() as (node, ctl, view):
+        vm = ctl.vm
+        await asyncio.to_thread(vm.refresh)
+        await asyncio.to_thread(vm.refresh_settings)
+        lines = vm.render_settings(100)
+        keys = vm.settings_keys()
+        assert len(lines) == len(keys)
+        assert any(ln.startswith("maxdownloadrate") for ln in lines)
+        idx = keys.index("maxdownloadrate")
+        await asyncio.to_thread(vm.update_setting, "maxdownloadrate",
+                                "555")
+        await asyncio.to_thread(vm.refresh_settings)
+        assert "= 555" in vm.render_settings(100)[idx]
+
+        frame = render_frame(vm, "Settings", 0, 100)
+        assert "[Settings]" in frame[0]
+        overlay = ["OVERLAY-MARKER", "line two"]
+        oframe = render_frame(vm, "Settings", 0, 100, overlay=overlay)
+        assert "OVERLAY-MARKER" in oframe[2]
+        assert "maxdownloadrate" not in "".join(oframe)

@@ -73,3 +73,23 @@ def test_format_timestamp_safe():
     assert out == time.strftime("%Y")
     # invalid format never raises
     assert i18n.format_timestamp(time.time(), "%") != ""
+
+
+def test_catalogs_cover_the_full_tr_surface():
+    """Every literal passed to tr() anywhere in the package has a
+    translation in BOTH shipped catalogs (VERDICT r3 #8: the machinery
+    worked but the catalogs didn't cover the UI surface)."""
+    import re
+    from pathlib import Path
+
+    pkg = Path(i18n.__file__).resolve().parent.parent
+    surface = set()
+    for py in pkg.rglob("*.py"):
+        surface.update(re.findall(r'\btr\(\s*"((?:[^"\\]|\\.)+)"',
+                                  py.read_text()))
+    assert len(surface) >= 40, "tr() surface scan looks broken"
+    for lang in ("de", "fr"):
+        catalog = i18n.parse_po(
+            (pkg / "locale" / f"{lang}.po").read_text())
+        missing = {s for s in surface if s not in catalog}
+        assert not missing, f"{lang}.po missing: {sorted(missing)}"

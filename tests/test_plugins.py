@@ -151,3 +151,34 @@ def test_autostart_plugin_roundtrip(tmp_path, monkeypatch):
     assert "pybitmessage_tpu" in entry.read_text()
     assert plugin(False) is True
     assert not entry.exists()
+
+
+def test_qr_v7_alignment_on_timing_row():
+    """Versions >= 7 REQUIRE alignment patterns centered on the timing
+    row/column (e.g. (6,22) in v7) — only the three finder corners are
+    skipped (ISO 18004 placement table)."""
+    m = qr.encode("x" * 150)        # v7+, n >= 45
+    n = len(m)
+    assert n >= 45
+    from pybitmessage_tpu.utils.qr import _ALIGN
+    version = (n - 17) // 4
+    centers = _ALIGN[version]
+    drawn = skipped = 0
+    for r in centers:
+        for c in centers:
+            corner = (r - 2 <= 7 and c - 2 <= 7) \
+                or (r - 2 <= 7 and c + 2 >= n - 8) \
+                or (r + 2 >= n - 8 and c - 2 <= 7)
+            if corner:
+                skipped += 1
+                continue
+            drawn += 1
+            # outer ring dark, inner ring light, center dark
+            assert m[r][c] is True
+            assert m[r - 1][c] is False and m[r][c - 1] is False
+            assert m[r - 2][c] is True and m[r][c - 2] is True
+    assert skipped == 3
+    assert drawn == len(centers) ** 2 - 3
+    # some center really sits on the timing row
+    assert any(r == 6 and c not in (6, centers[-1]) for r in centers
+               for c in centers if not (r == 6 and c == 6))

@@ -1,0 +1,166 @@
+"""pty-driven TUI integration: the real curses frontend against a real
+daemon process, one key at a time (VERDICT r3 #6 — pty-driven tests for
+the new panes: Settings editing, Subscriptions management, chan
+creation, QR overlay).
+
+curses repaints only changed cells, so assertions look for short
+substrings in the accumulated output stream, never whole lines.
+"""
+
+import os
+import pty
+import select
+import subprocess
+import sys
+import time
+
+import pytest
+
+DAEMON_ENV = dict(os.environ, JAX_PLATFORMS="cpu")
+API_USER, API_PASS = "ptyuser", "ptypass"
+
+
+class TuiSession:
+    def __init__(self, api_port):
+        self.master, slave = pty.openpty()
+        os.set_blocking(self.master, False)
+        env = dict(DAEMON_ENV, TERM="xterm", LINES="40", COLUMNS="120")
+        self.proc = subprocess.Popen(
+            [sys.executable, "-m", "pybitmessage_tpu.tui",
+             "--api-port", str(api_port),
+             "--api-user", API_USER, "--api-password", API_PASS],
+            stdin=slave, stdout=slave, stderr=subprocess.DEVNULL,
+            env=env, cwd=os.path.dirname(os.path.dirname(__file__)))
+        os.close(slave)
+        self.buf = b""
+
+    def pump(self, duration=1.0):
+        end = time.time() + duration
+        while time.time() < end:
+            r, _, _ = select.select([self.master], [], [], 0.2)
+            if r:
+                try:
+                    self.buf += os.read(self.master, 65536)
+                except OSError:
+                    break
+        return self.buf
+
+    def keys(self, data: bytes, settle=0.8):
+        os.write(self.master, data)
+        self.pump(settle)
+
+    def wait_for(self, needle: bytes, timeout=20.0, *, from_mark=0):
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if needle in self.buf[from_mark:]:
+                return True
+            self.pump(0.5)
+        return False
+
+    def mark(self) -> int:
+        return len(self.buf)
+
+    def close(self):
+        try:
+            os.write(self.master, b"q")
+            time.sleep(0.5)
+        except OSError:
+            pass
+        self.proc.terminate()
+        try:
+            self.proc.wait(10)
+        except subprocess.TimeoutExpired:
+            self.proc.kill()
+        os.close(self.master)
+
+
+@pytest.fixture
+def daemon(tmp_path):
+    home = tmp_path / "home"
+    api_port = 18650 + os.getpid() % 997
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "pybitmessage_tpu",
+         "-d", str(home), "-t", "-p", "0", "--no-udp", "--no-listen",
+         "--api-port", str(api_port),
+         "--api-user", API_USER, "--api-password", API_PASS],
+        env=DAEMON_ENV, cwd=os.path.dirname(os.path.dirname(__file__)),
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    deadline = time.time() + 90
+    log = home / "debug.log"
+    while time.time() < deadline:
+        if log.exists() and "API listening" in log.read_text():
+            break
+        assert proc.poll() is None, "daemon died during startup"
+        time.sleep(0.3)
+    else:
+        raise AssertionError("daemon never started its API")
+    yield api_port
+    proc.terminate()
+    try:
+        proc.wait(15)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+
+
+def test_tui_pane_tour_and_actions(daemon):
+    """One continuous session: create an identity, tour every pane,
+    QR overlay, subscribe, create a chan, edit a setting."""
+    tui = TuiSession(daemon)
+    try:
+        assert tui.wait_for(b"Inbox"), "TUI never painted"
+
+        # create an identity ('a'), then its QR overlay ('Q')
+        tui.keys(b"a")
+        tui.keys(b"pty identity\r", settle=2.0)
+        # Tab x2 -> Identities pane; grinding a keypair takes a moment
+        tui.keys(b"\t\t", settle=1.0)
+        assert tui.wait_for(b"pty identity", 30), "identity never listed"
+        mark = tui.mark()
+        tui.keys(b"Q", settle=2.0)
+        assert tui.wait_for("▀".encode(), 10, from_mark=mark) or \
+            tui.wait_for("█".encode(), 5, from_mark=mark), \
+            "QR overlay never painted"
+        tui.keys(b" ")                       # dismiss overlay
+
+        # chan creation on Identities pane
+        tui.keys(b"c")
+        tui.keys(b"pty chan phrase\r", settle=3.0)
+        assert tui.wait_for(b"(chan)", 30), "chan never listed"
+
+        # Subscriptions pane: add an entry by address
+        chan_addr = None
+        for tok in tui.buf.split():
+            if tok.startswith(b"BM-") and len(tok) > 30:
+                chan_addr = tok.decode()
+        assert chan_addr
+        tui.keys(b"\t", settle=0.6)          # -> Subscriptions
+        mark = tui.mark()
+        tui.keys(b"+")
+        tui.keys(chan_addr.encode() + b"\r")
+        tui.keys(b"pty feed\r", settle=2.0)
+        assert tui.wait_for(b"pty feed", 15, from_mark=mark), \
+            "subscription never listed"
+
+        # Settings pane: edit maxdownloadrate to 777
+        tui.keys(b"\t\t\t", settle=1.0)      # -> Settings
+        assert tui.wait_for(b"maxdownloadrate", 15), \
+            "settings pane never painted"
+        # move selection down to some row and back: pane renders rows
+        # sorted; select 'maxdownloadrate' by scanning keys client-side
+        from pybitmessage_tpu.cli import RPCClient
+        import json as _json
+        rpc = RPCClient("127.0.0.1", daemon, API_USER, API_PASS)
+        keys = sorted(k for k, v in _json.loads(
+            rpc.call("getSettings")).items()
+            if not isinstance(v, (list, dict)))
+        idx = keys.index("maxdownloadrate")
+        tui.keys(b"j" * idx, settle=1.0)
+        mark = tui.mark()
+        tui.keys(b"\r")                      # edit prompt
+        tui.keys(b"777\r", settle=2.0)
+        assert tui.wait_for(b"777", 15, from_mark=mark), \
+            "edited value never painted"
+        assert _json.loads(rpc.call("getSettings"))[
+            "maxdownloadrate"] == "777"
+    finally:
+        tui.close()
