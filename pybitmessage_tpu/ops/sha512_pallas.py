@@ -27,17 +27,22 @@ from .u64 import U32
 
 LANE_COLS = 128
 
-#: measured v5e sweet spot (r3 MFU experiment, BASELINE.md): FOUR
-#: independent 128-row tiles per grid step — the 160-round chains are
-#: dependency-limited, so extra instruction streams let the VPU
-#: dual/quad-issue.  Same-day same-chip ladder (rows=128, chunks=512):
-#: unroll=1: 77.8 MH/s, 2: 97.9, 3: 121.3, 4: 136.4, 6: 143.3 (compile
-#: 282 s — past the knee); 64-row streams lose (64x8: 133.5, 64x4:
-#: 90.2), two 256-row streams thrash VMEM (77.2), rows=512 exceeds the
-#: 16 MB scoped VMEM limit, chunks>=1024 fails to compile.
+#: measured v5e sweet spot: FIVE independent 128-row tiles per grid
+#: step — the 160-round chains are dependency-limited, so extra
+#: instruction streams let the VPU multi-issue.  r3 same-day ladder
+#: (rows=128, chunks=512): unroll=1: 77.8 MH/s, 2: 97.9, 3: 121.3,
+#: 4: 136.4, 6: 143.3; 64-row streams lose (64x8: 133.5, 64x4: 90.2),
+#: two 256-row streams thrash VMEM (77.2), rows=512 exceeds the 16 MB
+#: scoped VMEM limit, chunks>=1024 fails to compile.  r4 same-day
+#: ladder: 4: 138.0, 5: 149.2 (compile 170 s), 6: 151.0 (compile
+#: 228 s) — 5 is the knee.  A carry-save restructure of _add_many
+#: (hi parts summed as an independent tree off the carry chain)
+#: measured NEGATIVE same-day: 134.7 vs the 138.0 control — the VPU is
+#: issue-limited, not carry-latency-limited, so the only lever that
+#: moves the number is more independent streams.
 DEFAULT_ROWS = 128
 DEFAULT_CHUNKS = 512
-DEFAULT_UNROLL = 4
+DEFAULT_UNROLL = 5
 
 
 def _pair(value: int):
@@ -208,25 +213,31 @@ def _kernel(ih_ref, base_ref, target_ref, found_ref, nonce_ref, flag_ref, *,
         nonce_ref[step, 1] = n_lo
 
 
-def _batch_kernel(ih_ref, base_ref, target_ref, found_ref, nonce_ref,
-                  flag_ref, *, rows: int, unroll: int = 1):
+def _batch_kernel(ih_ref, base_ref, target_ref, out_ref, flag_ref,
+                  *, rows: int, unroll: int = 1):
     """2D grid (objects, chunks): each object owns a per-object early-
     exit flag, so easy objects stop costing compute while hard ones
     keep searching — the single-chip form of the (objects x
     nonce-lanes) batch design (SURVEY §6).  The search body is shared
     with the single-object kernel (_search_step), including its
     ``unroll`` independent instruction streams per grid step (the ILP
-    lever that lifted the single kernel 1.75x — BASELINE.md)."""
+    lever that lifted the single kernel 1.75x — BASELINE.md).
+
+    Output is written ONCE per object, on its hit step: a (B, 3) u32
+    row ``[hit_step + 1, nonce_hi, nonce_lo]`` (0 = not found).  r3's
+    (B, chunks)-shaped outputs made SMEM scale with the chunk count
+    and capped the batch at 16 objects (VERDICT r3 #2); the write-once
+    row is chunk-count-independent — 64 objects compile comfortably —
+    and the harvest is ONE small device->host fetch."""
     obj = pl.program_id(0)
     step = pl.program_id(1)
 
     @pl.when(step == 0)
-    def _init_flag():
+    def _init():
         flag_ref[obj] = jnp.int32(0)
-
-    found_ref[obj, step] = jnp.int32(0)
-    nonce_ref[obj, step, 0] = jnp.uint32(0)
-    nonce_ref[obj, step, 1] = jnp.uint32(0)
+        out_ref[obj, 0] = jnp.uint32(0)
+        out_ref[obj, 1] = jnp.uint32(0)
+        out_ref[obj, 2] = jnp.uint32(0)
 
     @pl.when(flag_ref[obj] == 0)
     def do_search():
@@ -234,10 +245,13 @@ def _batch_kernel(ih_ref, base_ref, target_ref, found_ref, nonce_ref,
             lambda i: (ih_ref[obj, i, 0], ih_ref[obj, i, 1]),
             base_ref[obj, 0], base_ref[obj, 1],
             target_ref[obj, 0], target_ref[obj, 1], step, rows, unroll)
-        found_ref[obj, step] = hit
         flag_ref[obj] = hit
-        nonce_ref[obj, step, 0] = n_hi
-        nonce_ref[obj, step, 1] = n_lo
+
+        @pl.when(hit == 1)
+        def _record():
+            out_ref[obj, 0] = jnp.uint32(step + 1)
+            out_ref[obj, 1] = n_hi
+            out_ref[obj, 2] = n_lo
 
 
 @functools.partial(jax.jit, static_argnames=("rows", "chunks", "interpret",
@@ -248,39 +262,41 @@ def pallas_batch_search(ih_words, bases, targets, rows: int = 256,
     """Search B objects' nonce ranges in ONE kernel launch.
 
     ``ih_words``: (B, 8, 2) uint32; ``bases``/``targets``: (B, 2).
-    Returns (found (B, chunks) int32, nonce (B, chunks, 2) uint32);
-    each grid step covers ``unroll`` consecutive (rows, 128) tiles.
+    Returns a (B, 3) uint32 array of ``[hit_step + 1, nonce_hi,
+    nonce_lo]`` rows (first column 0 = no hit in this launch); each
+    grid step covers ``unroll`` consecutive (rows, 128) tiles.
     """
     n_obj = ih_words.shape[0]
     kernel = functools.partial(_batch_kernel, rows=rows, unroll=unroll)
-    found, nonce = pl.pallas_call(
+    out = pl.pallas_call(
         kernel,
-        out_shape=(jax.ShapeDtypeStruct((n_obj, chunks), jnp.int32),
-                   jax.ShapeDtypeStruct((n_obj, chunks, 2), U32)),
+        out_shape=jax.ShapeDtypeStruct((n_obj, 3), U32),
         grid=(n_obj, chunks),
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.SMEM),
             pl.BlockSpec(memory_space=pltpu.SMEM),
             pl.BlockSpec(memory_space=pltpu.SMEM),
         ],
-        out_specs=(
-            pl.BlockSpec(memory_space=pltpu.SMEM),
-            pl.BlockSpec(memory_space=pltpu.SMEM),
-        ),
+        out_specs=pl.BlockSpec(memory_space=pltpu.SMEM),
         scratch_shapes=[pltpu.SMEM((n_obj,), jnp.int32)],
         interpret=interpret,
     )(ih_words, bases, targets)
-    return found, nonce
+    return out
 
 
 #: pad batches to this many objects per launch — one compiled program
 #: serves any batch size; always-hit targets make pad slots skip after
-#: their first chunk via the per-object flag.  r3: 16 objects/launch
-#: with the same ILP unroll as the single kernel (32 objects at these
-#: chunk counts exceeds the 1 MB SMEM budget: 1.17M used).
-BATCH_OBJS = 16
+#: their first chunk via the per-object flag.  r4: 32 objects/launch
+#: (measured on-chip: 32 compiles in 141 s / warm launch 0.28 s, 64 in
+#: 242 s / 0.45 s — the r3 16-object SMEM cap is gone with the
+#: write-once output row).
+BATCH_OBJS = 32
 BATCH_CHUNKS = 64
-BATCH_UNROLL = DEFAULT_UNROLL
+#: the batch grid keeps the measured unroll-4 configuration (32
+#: objects x 64 chunks x 4 streams compiled + verified on-chip r4);
+#: the storm is launch-overhead-bound, not VPU-bound, so the single
+#: kernel's unroll-5 knee doesn't transfer
+BATCH_UNROLL = 4
 
 
 def solve_batch(items, *, rows: int = DEFAULT_ROWS,
@@ -316,30 +332,34 @@ def solve_batch(items, *, rows: int = DEFAULT_ROWS,
         ih_words = jnp.array(
             [[[w >> 32, w & 0xFFFFFFFF] for w in ws] for ws in words],
             dtype=U32)
-        t_arr = jnp.array([[t >> 32, t & 0xFFFFFFFF] for t in targets],
-                          dtype=U32)
+        # all per-launch mutation is staged in NUMPY and converted once
+        # per launch: through the axon relay every tiny device op (an
+        # .at[].set per solved object) costs a round trip that used to
+        # dominate the storm wall clock
+        t_np = np.array([[t >> 32, t & 0xFFFFFFFF] for t in targets],
+                        dtype=np.uint32)
         bases = [0] * BATCH_OBJS
         trials = [0] * BATCH_OBJS
         done = [i >= len(group) for i in range(BATCH_OBJS)]
+        step_trials = rows * LANE_COLS * unroll
         while not all(done):
             if should_stop is not None and should_stop():
                 raise PowInterrupted("batched Pallas PoW interrupted")
             b_arr = jnp.array(
                 [[(b >> 32) & 0xFFFFFFFF, b & 0xFFFFFFFF] for b in bases],
                 dtype=U32)
-            found, nonce = pallas_batch_search(
-                ih_words, b_arr, t_arr, rows=rows,
+            out = np.asarray(pallas_batch_search(
+                ih_words, b_arr, jnp.array(t_np), rows=rows,
                 chunks=chunks_per_call, unroll=unroll,
-                interpret=interpret)
-            f = np.asarray(found)
-            nn = np.asarray(nonce)
+                interpret=interpret))
             for k in range(BATCH_OBJS):
                 if done[k]:
                     continue
-                trials[k] += trials_per_slab
-                idx = int(f[k].argmax())
-                if f[k][idx]:
-                    val = (int(nn[k, idx, 0]) << 32) | int(nn[k, idx, 1])
+                step1 = int(out[k, 0])
+                if step1:
+                    # trials credited up to the hit step, not the slab
+                    trials[k] += step1 * step_trials
+                    val = (int(out[k, 1]) << 32) | int(out[k, 2])
                     ih = items[group[k]][0]
                     check = double_sha512(val.to_bytes(8, "big") + ih)
                     if int.from_bytes(check[:8], "big") > targets[k]:
@@ -348,9 +368,9 @@ def solve_batch(items, *, rows: int = DEFAULT_ROWS,
                     results[group[k]] = (val, trials[k])
                     done[k] = True
                     # pad semantics: hit instantly next launch, then skip
-                    t_arr = t_arr.at[k].set(
-                        jnp.array([0xFFFFFFFF, 0xFFFFFFFF], dtype=U32))
+                    t_np[k] = (0xFFFFFFFF, 0xFFFFFFFF)
                 else:
+                    trials[k] += trials_per_slab
                     bases[k] = (bases[k] + trials_per_slab) & mask64
     return results
 

@@ -38,7 +38,8 @@ from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..ops.sha512_jax import DEFAULT_VARIANT, trial_values
-from ..ops.sha512_pallas import (BATCH_CHUNKS, LANE_COLS, DEFAULT_CHUNKS,
+from ..ops.sha512_pallas import (BATCH_CHUNKS, BATCH_OBJS, BATCH_UNROLL,
+                                 LANE_COLS, DEFAULT_CHUNKS,
                                  DEFAULT_ROWS, DEFAULT_UNROLL,
                                  pallas_batch_search, pallas_search)
 from ..ops.u64 import U32, add64, le64, mul_u32_const
@@ -46,13 +47,12 @@ from ..ops.pow_search import PowInterrupted
 
 _MASK64 = (1 << 64) - 1
 
-#: per-DEVICE object cap for the unrolled batch kernel: beyond ~16
-#: objects x 64 chunks x unroll 4 the kernel exceeds the 1 MB SMEM
-#: budget (BASELINE.md).  The host loop groups the batch so each
-#: device's local share stays within this, mirroring the single-chip
-#: ``solve_batch`` grouping — which is what lets the pod tier run the
-#: same ILP unroll (+38%) as the single-chip batch path.
-POD_BATCH_PER_DEVICE = 16
+#: per-DEVICE object cap for the unrolled batch kernel — the same
+#: 32-object geometry the single-chip ``solve_batch`` compiles and
+#: verifies on real hardware (r4: the write-once (B, 3) output row
+#: removed the r3 SMEM scaling that capped this at 16).  The host loop
+#: groups the batch so each device's local share stays within this.
+POD_BATCH_PER_DEVICE = BATCH_OBJS
 
 
 def default_impl() -> str:
@@ -180,16 +180,19 @@ def make_pallas_sharded_batch_search(mesh: Mesh, *,
 
         local_bases = jax.vmap(offset)(bases)
         if impl == "pallas":
-            found, nonce = pallas_batch_search(
+            # write-once (B, 3) rows: [hit_step+1, nonce_hi, nonce_lo]
+            out = pallas_batch_search(
                 ih_words, local_bases, targets, rows=rows, chunks=chunks,
                 unroll=unroll, interpret=interpret)
+            hit = (out[:, 0] > 0).astype(jnp.int32)
+            n_hi, n_lo = out[:, 1], out[:, 2]
         else:
             found, nonce = jax.vmap(
                 lambda iw, b, t: _xla_slab(iw, b, t, rows=rows,
                                            chunks=chunks * unroll,
                                            variant=variant)
             )(ih_words, local_bases, targets)
-        hit, n_hi, n_lo = jax.vmap(_first_hit)(found, nonce)
+            hit, n_hi, n_lo = jax.vmap(_first_hit)(found, nonce)
         hits = jax.lax.all_gather(hit, nonce_axis)        # (D, B_local)
         nhs = jax.lax.all_gather(n_hi, nonce_axis)
         nls = jax.lax.all_gather(n_lo, nonce_axis)
@@ -310,7 +313,7 @@ _ALWAYS_HIT = _MASK64
 def pallas_sharded_solve_batch(items, mesh: Mesh, *,
                                rows: int = DEFAULT_ROWS,
                                chunks_per_call: int = BATCH_CHUNKS,
-                               unroll: int = DEFAULT_UNROLL,
+                               unroll: int = BATCH_UNROLL,
                                impl: str | None = None,
                                interpret: bool = False,
                                variant: str = DEFAULT_VARIANT,
@@ -322,10 +325,11 @@ def pallas_sharded_solve_batch(items, mesh: Mesh, *,
     solves, its target flips to always-hit so its lanes stop after one
     chunk of the next launch, and its trials stop accruing; the batch
     is padded with always-hit dummies (never duplicated real work).
-    Defaults mirror the single-chip batch geometry (16 objects x 64
-    chunks x 4 streams per device) — the shape validated against the
-    SMEM budget on real hardware.  Returns ``[(nonce, trials), ...]``
-    aligned with ``items``.
+    Defaults mirror the single-chip batch geometry (32 objects x 64
+    chunks x 4 streams per device, ``BATCH_UNROLL`` — pinned to the
+    configuration compiled + verified on real hardware, independent of
+    the single kernel's unroll knee).  Returns ``[(nonce, trials),
+    ...]`` aligned with ``items``.
     """
     import numpy as np
 

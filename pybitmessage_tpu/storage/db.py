@@ -88,10 +88,15 @@ class Database:
                 # MIGRATIONS entry is the single source of truth
                 cur.execute("PRAGMA user_version = %d" % BASELINE_VERSION)
             self._migrate(cur)
-            cur.execute("PRAGMA user_version = %d" % SCHEMA_VERSION)
+            # only ever raise the stamp: a database touched by a NEWER
+            # build must keep its higher version or that build would
+            # re-run its migrations on an already-migrated schema
+            current = cur.execute("PRAGMA user_version").fetchone()[0]
+            stamp = max(current, SCHEMA_VERSION)
+            cur.execute("PRAGMA user_version = %d" % stamp)
             cur.execute(
                 "INSERT OR REPLACE INTO settings VALUES('version', ?)",
-                (str(SCHEMA_VERSION),))
+                (str(stamp),))
             cur.execute(
                 "INSERT OR IGNORE INTO settings VALUES('lastvacuumtime', ?)",
                 (int(time.time()),))

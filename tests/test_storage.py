@@ -252,3 +252,21 @@ def test_fresh_db_runs_migration_ladder_too(tmp_path):
     finally:
         dbmod.MIGRATIONS.pop(future)
         dbmod.SCHEMA_VERSION = old_version
+
+
+def test_version_stamp_never_downgrades(tmp_path):
+    """Opening a database touched by a NEWER build must not wind its
+    user_version back — the newer build would re-run its migrations."""
+    from pybitmessage_tpu.storage import db as dbmod
+
+    path = str(tmp_path / "newer.dat")
+    Database(path).close()
+    import sqlite3
+    raw = sqlite3.connect(path)
+    raw.execute("PRAGMA user_version = %d" % (dbmod.SCHEMA_VERSION + 5))
+    raw.commit()
+    raw.close()
+    d = Database(path)
+    assert d.query("PRAGMA user_version")[0][0] == dbmod.SCHEMA_VERSION + 5
+    assert d.get_setting("version") == str(dbmod.SCHEMA_VERSION + 5)
+    d.close()
