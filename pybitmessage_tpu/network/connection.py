@@ -137,23 +137,46 @@ class BMConnection:
 
     # -- framing -------------------------------------------------------------
 
+    async def _read_throttled(self, n: int) -> bytes:
+        """Read ``n`` bytes consuming download tokens BEFORE each
+        chunk, so a burst cannot outrun ``maxdownloadrate`` (the
+        reference throttles at recv granularity,
+        asyncore_pollchoose.py:109-130; r3 consumed the bucket only
+        after the payload was already buffered).  While this coroutine
+        sits in the bucket, the stream's flow control back-pressures
+        the peer once the read buffer fills."""
+        if n == 0:
+            return b""
+        bucket = self.ctx.download_bucket
+        chunks = []
+        remaining = n
+        while remaining:
+            take = min(remaining, 32768)
+            await bucket.consume(take)
+            chunks.append(await self.reader.readexactly(take))
+            remaining -= take
+            # a paced transfer IS activity: without this a low rate
+            # limit lets the inactivity reaper close a connection
+            # mid-payload while bytes are still flowing
+            self.last_activity = time.time()
+        return chunks[0] if len(chunks) == 1 else b"".join(chunks)
+
     async def _read_packet(self) -> None:
-        header = await self.reader.readexactly(HEADER_LEN)
+        header = await self._read_throttled(HEADER_LEN)
         # resync on bad magic: scan forward byte-at-a-time
         # (reference bmproto.py:85-98)
         while not header.startswith(struct.pack(">L", MAGIC)):
             nxt = header.find(struct.pack(">L", MAGIC)[0:1], 1)
             if nxt == -1:
-                header = await self.reader.readexactly(HEADER_LEN)
+                header = await self._read_throttled(HEADER_LEN)
                 continue
-            header = header[nxt:] + await self.reader.readexactly(nxt)
+            header = header[nxt:] + await self._read_throttled(nxt)
         command, length, checksum = unpack_header(header)
         if length > MAX_MESSAGE_SIZE:
             raise ConnectionClosed("oversize payload")
-        payload = await self.reader.readexactly(length)
+        payload = await self._read_throttled(length)
         if not verify_payload(payload, checksum):
             raise ConnectionClosed("bad checksum")
-        await self.ctx.download_bucket.consume(HEADER_LEN + length)
         self.last_activity = time.time()
         handler = getattr(self, "cmd_" + command, None)
         if handler is None:

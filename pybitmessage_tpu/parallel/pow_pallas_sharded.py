@@ -185,6 +185,7 @@ def make_pallas_sharded_batch_search(mesh: Mesh, *,
                 ih_words, local_bases, targets, rows=rows, chunks=chunks,
                 unroll=unroll, interpret=interpret)
             hit = (out[:, 0] > 0).astype(jnp.int32)
+            step1 = out[:, 0]
             n_hi, n_lo = out[:, 1], out[:, 2]
         else:
             found, nonce = jax.vmap(
@@ -193,14 +194,22 @@ def make_pallas_sharded_batch_search(mesh: Mesh, *,
                                            variant=variant)
             )(ih_words, local_bases, targets)
             hit, n_hi, n_lo = jax.vmap(_first_hit)(found, nonce)
+            # XLA slab reports the hit chunk index the same way
+            step1 = jnp.where(hit > 0,
+                              jnp.argmax(found > 0, axis=1) + 1,
+                              0).astype(U32)
         hits = jax.lax.all_gather(hit, nonce_axis)        # (D, B_local)
         nhs = jax.lax.all_gather(n_hi, nonce_axis)
         nls = jax.lax.all_gather(n_lo, nonce_axis)
+        steps = jax.lax.all_gather(step1, nonce_axis)
         win = jnp.argmax(hits, axis=0)
         lane = jnp.arange(hits.shape[1])
-        # packed (B_local, 3): one device->host fetch per harvest
+        # packed (B_local, 4): one device->host fetch per harvest;
+        # column 3 = winner's hit step (trials accounting parity with
+        # the single-chip solve_batch)
         return jnp.stack([jnp.any(hits, axis=0).astype(U32),
-                          nhs[win, lane], nls[win, lane]], axis=-1)
+                          nhs[win, lane], nls[win, lane],
+                          steps[win, lane]], axis=-1)
 
     fn = shard_map(
         body, mesh=mesh,
@@ -378,10 +387,23 @@ def pallas_sharded_solve_batch(items, mesh: Mesh, *,
             b_arr = jnp.stack([_pair_arr(b) for b in bases])
             packed = np.asarray(fn(ih_words, b_arr, t_arr))
             found, n_hi, n_lo = packed[:, 0], packed[:, 1], packed[:, 2]
+            steps = packed[:, 3]
+            # trials granularity of one reported hit step, per impl:
+            # a pallas grid step covers `unroll` tiles, an XLA chunk
+            # covers one
+            step_trials = rows * LANE_COLS * (
+                unroll if impl == "pallas" else 1)
             for i in range(group_objs):
                 if done[i]:
                     continue
-                trials[i] += stride
+                if found[i]:
+                    # parity with single-chip solve_batch: credit the
+                    # winning device up to its hit step; the other
+                    # devices ran their full slab concurrently
+                    trials[i] += (int(steps[i]) * step_trials
+                                  + (nonce_devs - 1) * slab)
+                else:
+                    trials[i] += stride
                 if found[i]:
                     nonce = (int(n_hi[i]) << 32) | int(n_lo[i])
                     check = double_sha512(
