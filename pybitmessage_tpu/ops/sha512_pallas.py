@@ -286,16 +286,21 @@ def pallas_batch_search(ih_words, bases, targets, rows: int = 256,
 
 #: pad batches to this many objects per launch — one compiled program
 #: serves any batch size; always-hit targets make pad slots skip after
-#: their first chunk via the per-object flag.  r4: 32 objects/launch
-#: (measured on-chip: 32 compiles in 141 s / warm launch 0.28 s, 64 in
-#: 242 s / 0.45 s — the r3 16-object SMEM cap is gone with the
-#: write-once output row).
-BATCH_OBJS = 32
+#: their first chunk via the per-object flag.  r4 on-chip measurements
+#: (the r3 16-object SMEM cap is gone with the write-once output row):
+#: launch wall is fixed-overhead dominated at low difficulty, so wider
+#: launches win the storm — 256-object test-difficulty storm ~300
+#: obj/s at 32-wide (8 launches) vs ~500 obj/s at 64-wide (4 launches,
+#: ~0.12 s each); at ~2^44 difficulty (every object searching ~1M
+#: trials) a 64-wide launch runs 0.45 s warm.  Mosaic compile for the
+#: 64-wide grid measured 146.5 s and 242 s in two different sessions
+#: (transient remote-compiler variance; 32-wide: 141 s).
+BATCH_OBJS = 64
 BATCH_CHUNKS = 64
-#: the batch grid keeps the measured unroll-4 configuration (32
-#: objects x 64 chunks x 4 streams compiled + verified on-chip r4);
-#: the storm is launch-overhead-bound, not VPU-bound, so the single
-#: kernel's unroll-5 knee doesn't transfer
+#: the batch grid keeps the unroll-4 configuration (64 objects x 64
+#: chunks x 4 streams compiled + solve-verified on-chip r4); the storm
+#: is launch-overhead-bound, not VPU-bound, so the single kernel's
+#: unroll-5 knee doesn't transfer
 BATCH_UNROLL = 4
 
 

@@ -48,7 +48,7 @@ from ..ops.pow_search import PowInterrupted
 _MASK64 = (1 << 64) - 1
 
 #: per-DEVICE object cap for the unrolled batch kernel — the same
-#: 32-object geometry the single-chip ``solve_batch`` compiles and
+#: 64-object geometry the single-chip ``solve_batch`` compiles and
 #: verifies on real hardware (r4: the write-once (B, 3) output row
 #: removed the r3 SMEM scaling that capped this at 16).  The host loop
 #: groups the batch so each device's local share stays within this.
