@@ -39,7 +39,8 @@ async def live_vm():
 def test_registry_parses_and_covers_core_screens():
     reg = load_registry()
     for required in ("inbox", "sent", "identities", "subscriptions",
-                     "addressbook", "blacklist", "network", "compose"):
+                     "addressbook", "blacklist", "network", "compose",
+                     "settings", "chan"):
         assert required in reg, "screen %r missing" % required
 
 
@@ -115,6 +116,47 @@ async def test_screens_drive_live_node():
     mode = await asyncio.to_thread(
         screens["blacklist"].actions["toggle_mode"])
     assert mode == "white"
+
+    # r4 surfaces: settings render + update action round-trips...
+    await asyncio.to_thread(vm.refresh_settings)
+    assert any(ln.startswith("maxdownloadrate")
+               for ln in screens["settings"].render(100))
+    await asyncio.to_thread(
+        screens["settings"].actions["update"], "maxdownloadrate", "321")
+    await asyncio.to_thread(vm.refresh_settings)
+    assert any("= 321" in ln and ln.startswith("maxdownloadrate")
+               for ln in screens["settings"].render(100))
+
+    # ...chan create via the form, join via the action
+    chan_addr = await asyncio.to_thread(
+        screens["chan"].submit, "mobile chan phrase")
+    assert chan_addr.startswith("BM-")
+    await asyncio.to_thread(vm.refresh)
+    assert any(a["chan"] for a in vm.addresses)
+    idx = [i for i, a in enumerate(vm.addresses) if a["chan"]][0]
+    # QR + mailing-list actions on the identities screen
+    qr_lines = screens["identities"].actions["qr"](idx)
+    assert qr_lines[0].startswith("bitmessage:BM-")
+    assert await asyncio.to_thread(
+        screens["identities"].actions["toggle_mailing_list"], 0, "ml")
+    # subscriptions form + delete action
+    await asyncio.to_thread(
+        screens["subscriptions"].submit, chan_addr, "chan feed")
+    await asyncio.to_thread(vm.refresh)
+    assert vm.subscriptions
+    await asyncio.to_thread(screens["subscriptions"].actions["delete"], 0)
+    await asyncio.to_thread(vm.refresh)
+    assert vm.subscriptions == []
+    # leaving the chan via the identities action
+    await asyncio.to_thread(
+        screens["identities"].actions["leave_chan"], idx)
+    await asyncio.to_thread(vm.refresh)
+    assert not any(a["chan"] for a in vm.addresses)
+    # join round-trips through the deterministic address
+    await asyncio.to_thread(
+        screens["chan"].actions["join"], "mobile chan phrase", chan_addr)
+    await asyncio.to_thread(vm.refresh)
+    assert any(a["chan"] for a in vm.addresses)
 
 
 def test_registry_file_is_valid_json_with_comment_convention():
