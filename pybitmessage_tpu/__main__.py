@@ -118,13 +118,6 @@ async def run(args) -> int:
         "maxacceptablenoncetrialsperbyte")
     node.sender.max_acceptable_extra = settings.getint(
         "maxacceptablepayloadlengthextrabytes")
-    if settings.get("onionhostname"):
-        # publish our hidden-service endpoint as an ONIONPEER object at
-        # worker startup (reference sendOnionPeerObj)
-        # lowercase: the wire codec round-trips onion hosts in
-        # lowercase, and the self-recognition check compares exactly
-        node.sender.onion_peer = (settings.get("onionhostname").lower(),
-                                  settings.getint("onionport"))
     if settings.get("sockstype") not in ("none", "SOCKS5", "SOCKS4a"):
         # a plugin name (e.g. "stem"): let it launch/adopt a proxy and
         # rewrite the socks settings (reference start_proxyconfig).
@@ -145,6 +138,14 @@ async def run(args) -> int:
             "username": settings.get("socksusername"),
             "password": settings.get("sockspassword"),
         }
+    # AFTER proxyconfig: a plugin may have just created the hidden
+    # service and set onionhostname.  Publish our endpoint as an
+    # ONIONPEER object at worker startup (reference sendOnionPeerObj);
+    # lowercase because the wire codec round-trips onion hosts in
+    # lowercase and the self-recognition check compares exactly.
+    if settings.get("onionhostname"):
+        node.sender.onion_peer = (settings.get("onionhostname").lower(),
+                                  settings.getint("onionport"))
     if args.trusted_peer:
         host, _, port = args.trusted_peer.rpartition(":")
         node.pool.trusted_peer = Peer(host, int(port))

@@ -61,6 +61,9 @@ DEFAULTS: dict[str, str] = {
     "sockslisten": "false",
     "onionhostname": "",
     "onionport": "8444",
+    "torcontrolport": "0",           # adopted-tor control port (0 = none)
+    "onionservicekey": "",           # persisted ephemeral-service key
+    "onionservicekeytype": "",
     "namecoinrpctype": "namecoind",
     "namecoinrpchost": "localhost",
     "namecoinrpcport": "8336",

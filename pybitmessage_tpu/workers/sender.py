@@ -441,7 +441,11 @@ class SendWorker:
         try:
             body = encode_varint(port) + encode_host(host)
         except Exception:
-            logger.warning("cannot encode onion endpoint %r", host)
+            # expected for v3 onions (56 chars > the 16-byte addr
+            # field): the service still serves inbound Tor dials, it
+            # just can't be flooded — debug, not a per-start warning
+            logger.debug("onion endpoint %r not wire-encodable; "
+                         "skipping ONIONPEER announcement", host)
             return
         tag = inventory_hash(body)
         if any(item.expires > time.time() for item in

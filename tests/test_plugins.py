@@ -182,3 +182,179 @@ def test_qr_v7_alignment_on_timing_row():
     # some center really sits on the timing row
     assert any(r == 6 and c not in (6, centers[-1]) for r in centers
                for c in centers if not (r == 6 and c == 6))
+
+
+# -- hidden service over the Tor control protocol ----------------------------
+
+class FakeTorControl:
+    """Scripted control-port server: AUTHENTICATE + ADD_ONION."""
+
+    def __init__(self, *, cookie: bytes | None = None,
+                 cookiefile_advertised: str | None = None,
+                 service_id="q" * 56, private_key="ED25519-V3:c2VjcmV0"):
+        self.cookie = cookie
+        self.cookiefile_advertised = cookiefile_advertised
+        self.service_id = service_id
+        self.private_key = private_key
+        self.requests: list[str] = []
+        self.srv = socket.socket()
+        self.srv.bind(("127.0.0.1", 0))
+        self.srv.listen(2)
+        self.port = self.srv.getsockname()[1]
+        threading.Thread(target=self._serve, daemon=True).start()
+
+    def _serve(self):
+        while True:
+            try:
+                conn, _ = self.srv.accept()
+            except OSError:
+                return
+            f = conn.makefile("rwb")
+            authed = False
+            while True:
+                raw = f.readline()
+                if not raw:
+                    break
+                line = raw.decode().strip()
+                self.requests.append(line)
+                if line.startswith("PROTOCOLINFO"):
+                    f.write(b"250-PROTOCOLINFO 1\r\n")
+                    if self.cookiefile_advertised:
+                        f.write(
+                            b'250-AUTH METHODS=COOKIE,SAFECOOKIE '
+                            b'COOKIEFILE="'
+                            + self.cookiefile_advertised.encode()
+                            + b'"\r\n')
+                    else:
+                        f.write(b"250-AUTH METHODS=NULL\r\n")
+                    f.write(b"250 OK\r\n")
+                elif line.startswith("AUTHENTICATE"):
+                    given = line.partition(" ")[2]
+                    ok = (self.cookie is None and not given) or \
+                        (self.cookie is not None
+                         and given == self.cookie.hex())
+                    f.write(b"250 OK\r\n" if ok
+                            else b"515 Bad authentication\r\n")
+                    authed = ok
+                elif line.startswith("ADD_ONION"):
+                    if not authed:
+                        f.write(b"514 Authentication required\r\n")
+                    else:
+                        reply = f"250-ServiceID={self.service_id}\r\n"
+                        if "NEW:" in line:
+                            reply += f"250-PrivateKey={self.private_key}\r\n"
+                        reply += "250 OK\r\n"
+                        f.write(reply.encode())
+                elif line == "QUIT":
+                    f.write(b"250 closing connection\r\n")
+                    f.flush()
+                    break
+                else:
+                    f.write(b"510 Unrecognized command\r\n")
+                f.flush()
+            conn.close()
+
+    def close(self):
+        self.srv.close()
+
+
+def test_hidden_service_created_and_key_persisted(tmp_path):
+    """sockslisten + a reachable control port -> ADD_ONION NEW:BEST,
+    onionhostname set, returned key persisted for the next start
+    (reference proxyconfig_stem.py:110-155)."""
+    import pybitmessage_tpu.plugins.proxyconfig_stem as stem
+
+    ctl = FakeTorControl()
+    try:
+        s = Settings(tmp_path / "settings.dat")
+        s.set_temp("port", 17001)
+        s.set_temp("onionport", 8444)
+        assert stem._publish_hidden_service(s, ctl.port, None) is True
+        assert s.get("onionhostname") == "q" * 56 + ".onion"
+        assert s.get("onionservicekeytype") == "ED25519-V3"
+        assert s.get("onionservicekey") == "c2VjcmV0"
+        assert any(r == "AUTHENTICATE" for r in ctl.requests)
+        assert any(r.startswith("ADD_ONION NEW:BEST Flags=Detach Port=8444,17001")
+                   for r in ctl.requests)
+
+        # second run: the saved key is REUSED (no NEW: in the command)
+        ctl.requests.clear()
+        assert stem._publish_hidden_service(s, ctl.port, None) is True
+        add = [r for r in ctl.requests if r.startswith("ADD_ONION")]
+        assert add and add[0].startswith("ADD_ONION ED25519-V3:c2VjcmV0 Flags=Detach ")
+    finally:
+        ctl.close()
+
+
+def test_hidden_service_cookie_auth(tmp_path):
+    import pybitmessage_tpu.plugins.proxyconfig_stem as stem
+
+    cookie = b"\x01\x02cookiebytes\xff"
+    cookie_file = tmp_path / "control_auth_cookie"
+    cookie_file.write_bytes(cookie)
+    ctl = FakeTorControl(cookie=cookie)
+    try:
+        s = Settings()
+        s.set_temp("port", 17002)
+        assert stem._publish_hidden_service(
+            s, ctl.port, str(cookie_file)) is True
+        assert s.get("onionhostname").endswith(".onion")
+        assert any(r == "AUTHENTICATE " + cookie.hex()
+                   for r in ctl.requests)
+    finally:
+        ctl.close()
+
+
+def test_hidden_service_cookie_discovered_via_protocolinfo(tmp_path):
+    """Adopted system Tors default to cookie auth: the cookie path is
+    discovered through PROTOCOLINFO when none is configured."""
+    import pybitmessage_tpu.plugins.proxyconfig_stem as stem
+
+    cookie = b"system-tor-cookie-32-bytes......"
+    cookie_file = tmp_path / "sys_cookie"
+    cookie_file.write_bytes(cookie)
+    ctl = FakeTorControl(cookie=cookie,
+                         cookiefile_advertised=str(cookie_file))
+    try:
+        s = Settings()
+        s.set_temp("port", 17004)
+        assert stem._publish_hidden_service(s, ctl.port, None) is True
+        assert any(r == "AUTHENTICATE " + cookie.hex()
+                   for r in ctl.requests)
+        assert s.get("onionhostname").endswith(".onion")
+    finally:
+        ctl.close()
+
+
+def test_hidden_service_failure_is_soft(tmp_path):
+    """An unreachable control port degrades to a warning — the proxy
+    itself stays configured (outbound anonymity unaffected)."""
+    import pybitmessage_tpu.plugins.proxyconfig_stem as stem
+
+    s = Settings()
+    assert stem._publish_hidden_service(s, 1, None) is False
+    assert s.get("onionhostname") == ""
+
+
+def test_connect_plugin_full_tor_story_with_adopted_proxy(tmp_path):
+    """Adopted SOCKS proxy + torcontrolport: connect_plugin configures
+    the proxy AND publishes the hidden service in one pass."""
+    proxy = socket.socket()
+    proxy.bind(("127.0.0.1", 0))
+    proxy.listen(2)
+    threading.Thread(target=lambda: [proxy.accept() for _ in range(9)],
+                     daemon=True).start()
+    ctl = FakeTorControl(service_id="w" * 56)
+    try:
+        s = Settings(tmp_path / "settings.dat")
+        s.set_temp("sockstype", "stem")
+        s.set_temp("socksport", proxy.getsockname()[1])
+        s.set_temp("sockslisten", True)
+        s.set_temp("torcontrolport", ctl.port)
+        s.set_temp("port", 17003)
+        assert start_proxyconfig(s) is True
+        assert s.get("sockstype") == "SOCKS5"
+        assert s.get("onionhostname") == "w" * 56 + ".onion"
+    finally:
+        proxy.close()
+        ctl.close()
