@@ -180,9 +180,8 @@ def _h_saveattachment(rpc, argv):
     import os
     msgid = argv[0]
     directory = argv[1] if len(argv) > 1 else "."
-    out = json.loads(rpc.call("getInboxMessageById", msgid, True))
     saved = 0
-    for m in out["inboxMessage"]:
+    for m in _fetch_message(rpc, msgid):
         attachments, _ = extract_attachments(_unb64(m["message"]))
         for name, data in attachments:
             # sender-controlled filename: basename only, never empty —
@@ -245,10 +244,19 @@ def _h_sent(rpc, argv):
               f"{_unb64(m['subject'])!r}  [{m['status']}]")
 
 
+def _fetch_message(rpc, msgid: str) -> list[dict]:
+    """Inbox lookup with outbox fallback — sent msgids are distinct
+    handles (random, vs the inbox's inventory hash), and the reference
+    CLI reads/extracts from both tables."""
+    out = json.loads(rpc.call("getInboxMessageById", msgid, True))
+    if out["inboxMessage"]:
+        return out["inboxMessage"]
+    return json.loads(rpc.call("getSentMessageById", msgid))["sentMessage"]
+
+
 def _h_read(rpc, argv):
     from .utils.safetext import extract_links, sanitize, sanitize_line
-    out = json.loads(rpc.call("getInboxMessageById", argv[0], True))
-    for m in out["inboxMessage"]:
+    for m in _fetch_message(rpc, argv[0]):
         raw = _unb64(m["message"])
         attachments, raw = extract_attachments(raw)
         print(f"From:    {m['fromAddress']}")

@@ -263,3 +263,32 @@ def test_extract_attachments_hostile_trailing_alt_terminates():
     atts2, _ = extract_attachments(two_tags)
     assert atts2[0][0] == "right.bin"
     assert atts2[0][1] == b"BBB"
+
+
+@pytest.mark.asyncio
+async def test_saveattachment_from_sent_message(tmp_path):
+    """The reference CLI extracts attachments from the outbox too:
+    a msgid not found in the inbox falls back to the sent table."""
+    async with live_api() as (node, rpc):
+        addr = (await _run(rpc, "createaddress", ["out"])).strip()
+        src = tmp_path / "outbound.bin"
+        payload = b"sent-side attachment" * 50
+        src.write_bytes(payload)
+        await _run(rpc, "sendfile", [addr, addr, "out subj", str(src)])
+        for _ in range(400):
+            if node.store.inbox():
+                break
+            await asyncio.sleep(0.05)
+        sent_out = await _run(rpc, "sent")
+        msgid = sent_out.split()[0]
+        # a sent msgid is a distinct random handle (core/node.py), so
+        # the inbox lookup is empty by construction and the outbox
+        # fallback is what serves this id
+        outdir = tmp_path / "saved"
+        outdir.mkdir()
+        save_out = await _run(rpc, "saveattachment", [msgid, str(outdir)])
+        assert "saved" in save_out
+        assert (outdir / "outbound.bin").read_bytes() == payload
+        # `read` resolves the same sent msgid (shared lookup helper)
+        read_out = await _run(rpc, "read", [msgid])
+        assert "[attachment: outbound.bin" in read_out
