@@ -304,6 +304,44 @@ BATCH_CHUNKS = 64
 BATCH_UNROLL = 4
 
 
+class _BatchGroup:
+    """Host state for one ``BATCH_OBJS``-wide launch group."""
+
+    __slots__ = ("idx", "ih_words", "t_np", "t_dev", "t_dirty", "targets",
+                 "bases", "trials", "done", "harvested")
+
+    def __init__(self, items, idx, mask64):
+        import numpy as np
+
+        pad = BATCH_OBJS - len(idx)
+        ihs = [items[i][0] for i in idx] + [b"\x00" * 64] * pad
+        self.targets = ([items[i][1] & mask64 for i in idx]
+                        + [mask64] * pad)
+        words = [[int.from_bytes(ih[j:j + 8], "big")
+                  for j in range(0, 64, 8)] for ih in ihs]
+        self.ih_words = jnp.array(
+            [[[w >> 32, w & 0xFFFFFFFF] for w in ws] for ws in words],
+            dtype=U32)
+        # all per-launch mutation is staged in NUMPY and converted once
+        # per launch: through the axon relay every tiny device op (an
+        # .at[].set per solved object) costs a round trip that used to
+        # dominate the storm wall clock
+        self.t_np = np.array(
+            [[t >> 32, t & 0xFFFFFFFF] for t in self.targets],
+            dtype=np.uint32)
+        self.idx = idx
+        self.t_dev = None       # device-resident targets (lazy upload)
+        self.t_dirty = True     # re-upload only after a target flips
+        self.bases = [0] * BATCH_OBJS
+        self.trials = [0] * BATCH_OBJS
+        self.done = [i >= len(idx) for i in range(BATCH_OBJS)]
+        self.harvested = 0
+
+    @property
+    def finished(self) -> bool:
+        return all(self.done)
+
+
 def solve_batch(items, *, rows: int = DEFAULT_ROWS,
                 chunks_per_call: int = BATCH_CHUNKS,
                 unroll: int = BATCH_UNROLL, should_stop=None,
@@ -314,9 +352,19 @@ def solve_batch(items, *, rows: int = DEFAULT_ROWS,
     ``BATCH_OBJS`` objects share each kernel launch; solved (and pad)
     objects flip their per-object flag and stop consuming grid steps.
     Returns ``[(nonce, trials), ...]`` aligned with ``items``.
-    """
-    import numpy as np
 
+    The host loop keeps ONE launch in flight ahead of the one being
+    harvested (the same pipeline as the single-object :func:`solve`):
+    bases advance optimistically at dispatch, and a launch is dispatched
+    for the NEXT group (or, for a group that has already proven it needs
+    more than one slab, the next slab of the same group) before the
+    pending launch's results are pulled, so the relay round trip and the
+    per-object host bookkeeping hide behind device compute.  A
+    speculative tail launch dispatched for a group whose pending launch
+    turns out to have finished it is abandoned unfetched; since every
+    finished object's target is flipped to always-hit, such a launch
+    exits after one chunk per object and costs almost nothing.
+    """
     from ..utils.hashes import double_sha512
     from .pow_search import PowInterrupted
 
@@ -326,58 +374,87 @@ def solve_batch(items, *, rows: int = DEFAULT_ROWS,
     results: list = [None] * n
     mask64 = (1 << 64) - 1
     trials_per_slab = rows * LANE_COLS * chunks_per_call * unroll
+    step_trials = rows * LANE_COLS * unroll
 
-    for group_start in range(0, n, BATCH_OBJS):
-        group = list(range(group_start, min(group_start + BATCH_OBJS, n)))
-        pad = BATCH_OBJS - len(group)
-        ihs = [items[i][0] for i in group] + [b"\x00" * 64] * pad
-        targets = [items[i][1] & mask64 for i in group] + [mask64] * pad
-        words = [[int.from_bytes(ih[j:j + 8], "big")
-                  for j in range(0, 64, 8)] for ih in ihs]
-        ih_words = jnp.array(
-            [[[w >> 32, w & 0xFFFFFFFF] for w in ws] for ws in words],
-            dtype=U32)
-        # all per-launch mutation is staged in NUMPY and converted once
-        # per launch: through the axon relay every tiny device op (an
-        # .at[].set per solved object) costs a round trip that used to
-        # dominate the storm wall clock
-        t_np = np.array([[t >> 32, t & 0xFFFFFFFF] for t in targets],
-                        dtype=np.uint32)
-        bases = [0] * BATCH_OBJS
-        trials = [0] * BATCH_OBJS
-        done = [i >= len(group) for i in range(BATCH_OBJS)]
-        step_trials = rows * LANE_COLS * unroll
-        while not all(done):
-            if should_stop is not None and should_stop():
-                raise PowInterrupted("batched Pallas PoW interrupted")
-            b_arr = jnp.array(
-                [[(b >> 32) & 0xFFFFFFFF, b & 0xFFFFFFFF] for b in bases],
-                dtype=U32)
-            out = np.asarray(pallas_batch_search(
-                ih_words, b_arr, jnp.array(t_np), rows=rows,
-                chunks=chunks_per_call, unroll=unroll,
-                interpret=interpret))
-            for k in range(BATCH_OBJS):
-                if done[k]:
-                    continue
-                step1 = int(out[k, 0])
-                if step1:
-                    # trials credited up to the hit step, not the slab
-                    trials[k] += step1 * step_trials
-                    val = (int(out[k, 1]) << 32) | int(out[k, 2])
-                    ih = items[group[k]][0]
-                    check = double_sha512(val.to_bytes(8, "big") + ih)
-                    if int.from_bytes(check[:8], "big") > targets[k]:
-                        raise ArithmeticError(
-                            "accelerator returned an invalid nonce")
-                    results[group[k]] = (val, trials[k])
-                    done[k] = True
-                    # pad semantics: hit instantly next launch, then skip
-                    t_np[k] = (0xFFFFFFFF, 0xFFFFFFFF)
-                else:
-                    trials[k] += trials_per_slab
-                    bases[k] = (bases[k] + trials_per_slab) & mask64
-    return results
+    groups = [
+        _BatchGroup(items,
+                    list(range(s, min(s + BATCH_OBJS, n))), mask64)
+        for s in range(0, n, BATCH_OBJS)
+    ]
+
+    def dispatch(g: _BatchGroup):
+        import numpy as np
+
+        b_arr = np.array(
+            [[(b >> 32) & 0xFFFFFFFF, b & 0xFFFFFFFF] for b in g.bases],
+            dtype=np.uint32)
+        # targets change only when an object solves; keeping the device
+        # copy across launches saves one host->device transfer (a full
+        # relay round trip) on every steady-state launch
+        if g.t_dirty:
+            g.t_dev = jnp.asarray(g.t_np.copy())
+            g.t_dirty = False
+        out = pallas_batch_search(
+            g.ih_words, b_arr, g.t_dev, rows=rows,
+            chunks=chunks_per_call, unroll=unroll, interpret=interpret)
+        for k in range(BATCH_OBJS):
+            if not g.done[k]:
+                g.bases[k] = (g.bases[k] + trials_per_slab) & mask64
+        return out
+
+    def harvest(g: _BatchGroup, out_dev):
+        import numpy as np
+
+        out = np.asarray(out_dev)
+        for k in range(BATCH_OBJS):
+            if g.done[k]:
+                continue
+            step1 = int(out[k, 0])
+            if step1:
+                # trials credited up to the hit step, not the slab
+                g.trials[k] += step1 * step_trials
+                val = (int(out[k, 1]) << 32) | int(out[k, 2])
+                ih = items[g.idx[k]][0]
+                check = double_sha512(val.to_bytes(8, "big") + ih)
+                if int.from_bytes(check[:8], "big") > g.targets[k]:
+                    raise ArithmeticError(
+                        "accelerator returned an invalid nonce")
+                results[g.idx[k]] = (val, g.trials[k])
+                g.done[k] = True
+                # pad semantics: hit instantly next launch, then skip
+                g.t_np[k] = (0xFFFFFFFF, 0xFFFFFFFF)
+                g.t_dirty = True
+            else:
+                g.trials[k] += trials_per_slab
+        g.harvested += 1
+
+    pending = None  # (group, in-flight device output)
+    rr = 0          # round-robin dispatch cursor over groups
+    while True:
+        if should_stop is not None and should_stop():
+            raise PowInterrupted("batched Pallas PoW interrupted")
+        live = [g for g in groups if not g.finished]
+        if not live and pending is None:
+            return results
+        pending_g = pending[0] if pending is not None else None
+        # round-robin over unfinished groups, never the pending one
+        # (its next slab would be speculative while fresh work exists);
+        # otherwise speculate one slab ahead on a group that has
+        # already needed >=1 full slab without finishing
+        cand = None
+        for off in range(len(groups)):
+            g = groups[(rr + off) % len(groups)]
+            if not g.finished and g is not pending_g:
+                cand = g
+                rr = (rr + off + 1) % len(groups)
+                break
+        if cand is None and pending_g is not None \
+                and pending_g.harvested >= 1 and not pending_g.finished:
+            cand = pending_g
+        cur = (cand, dispatch(cand)) if cand is not None else None
+        if pending is not None and not pending[0].finished:
+            harvest(*pending)
+        pending = cur
 
 
 @functools.partial(jax.jit, static_argnames=("rows", "chunks", "interpret",
