@@ -488,6 +488,21 @@ class CommandHandler:
         msgs = [self._sent_json(m) for m in self.node.store.all_sent()]
         return json.dumps({"sentMessages": msgs}, indent=4)
 
+    def cmd_searchMessages(self, what, folder="inbox", where=""):
+        """Store-backed LIKE search (reference helper_search.search_sql,
+        the query behind the Qt search bar and curses search).  ``folder``
+        is inbox/sent/trash/new; ``where`` optionally restricts to
+        toaddress/fromaddress/subject/message."""
+        hits = self.node.store.search(str(folder), str(what),
+                                      str(where) or None)
+        if folder == "sent":
+            return json.dumps(
+                {"sentMessages": [self._sent_json(m) for m in hits]},
+                indent=4)
+        return json.dumps(
+            {"inboxMessages": [self._inbox_json(m) for m in hits]},
+            indent=4)
+
     def cmd_getAllSentMessageIds(self):
         msgs = [{"msgid": hexlify(m.msgid).decode()}
                 for m in self.node.store.all_sent()]
@@ -578,6 +593,56 @@ class CommandHandler:
         TTL = max(60 * 60, min(int(TTL), 28 * 24 * 3600))
         ack = await self.node.send_broadcast(
             fromAddress, subject, message, ttl=TTL, encoding=encodingType)
+        return hexlify(ack).decode()
+
+    # -- email gateway (reference bitmessageqt/account.py:185-345) -----------
+
+    def cmd_setEmailGateway(self, address, gateway, registration="",
+                            unregistration="", relay=""):
+        """Register/unregister one of our identities with an email
+        gateway operator ('mailchuck' ships built in; the three
+        service addresses can be overridden for other operators).
+        Empty gateway clears the setting."""
+        if self.node.keystore.get(address) is None:
+            raise APIError(13)
+        self.node.set_email_gateway(
+            address, str(gateway), registration=str(registration),
+            unregistration=str(unregistration), relay=str(relay))
+        return "Set email gateway of %s to %r" % (address, str(gateway))
+
+    async def _gateway_cmd(self, address, action, email=""):
+        try:
+            ack = await self.node.email_gateway_command(
+                str(address), action, email=str(email))
+        except KeyError as exc:
+            raise APIError(13, str(exc))
+        return hexlify(ack).decode()
+
+    async def cmd_emailGatewayRegister(self, address, email):
+        """Request an email address from the identity's gateway."""
+        return await self._gateway_cmd(address, "register", email)
+
+    async def cmd_emailGatewayUnregister(self, address):
+        return await self._gateway_cmd(address, "unregister")
+
+    async def cmd_emailGatewayStatus(self, address):
+        return await self._gateway_cmd(address, "status")
+
+    async def cmd_emailGatewaySettings(self, address):
+        """Send the commented settings template to the gateway."""
+        return await self._gateway_cmd(address, "settings")
+
+    async def cmd_sendEmail(self, fromAddress, toEmail, subject, message):
+        """Send an email through the registered gateway's relay
+        (subject/message base64 like sendMessage)."""
+        if "@" not in str(toEmail):
+            raise APIError(0, "toEmail does not look like an email")
+        try:
+            ack = await self.node.send_email(
+                str(fromAddress), str(toEmail), _from_b64(subject),
+                _from_b64(message))
+        except KeyError as exc:
+            raise APIError(13, str(exc))
         return hexlify(ack).decode()
 
     def cmd_getStatus(self, ackdata_hex):

@@ -219,15 +219,14 @@ def _h_inbox(rpc, argv):
 
 
 def _h_search(rpc, argv):
-    """Case-insensitive search over subject/body/addresses (role of the
-    reference's helper_search used by its UIs)."""
-    needle = argv[0].lower()
-    msgs = json.loads(rpc.call("getAllInboxMessages"))["inboxMessages"]
-    hits = [m for m in msgs
-            if needle in _unb64(m["subject"]).lower()
-            or needle in _unb64(m["message"]).lower()
-            or needle in m["fromAddress"].lower()
-            or needle in m["toAddress"].lower()]
+    """Case-insensitive search over subject/body/addresses via the
+    store-backed ``searchMessages`` command (role of the reference's
+    helper_search used by its UIs).  Optional second arg: folder
+    (inbox/sent/trash/new); third: field restriction."""
+    folder = argv[1] if len(argv) > 1 else "inbox"
+    where = argv[2] if len(argv) > 2 else ""
+    out = json.loads(rpc.call("searchMessages", argv[0], folder, where))
+    hits = out.get("inboxMessages") or out.get("sentMessages") or []
     if not hits:
         print("(no matches)")
     for m in hits:
@@ -335,6 +334,38 @@ def _h_shutdown(rpc, argv):
     print(rpc.call("shutdown"))
 
 
+def _h_emailgateway(rpc, argv):
+    """Email-gateway account management (reference account.py flows):
+    emailgateway set <address> <gateway> [reg unreg relay]
+    emailgateway register <address> <email> | unregister | status |
+    settings <address>"""
+    action = argv[0]
+    needed = {"set": 3, "register": 3, "unregister": 2, "status": 2,
+              "settings": 2}
+    if action not in needed or len(argv) < needed[action]:
+        raise CommandError(
+            "usage: emailgateway set <addr> <gateway> [reg unreg relay]"
+            " | register <addr> <email>"
+            " | unregister|status|settings <addr>")
+    if action == "set":
+        print(rpc.call("setEmailGateway", argv[1], argv[2], *argv[3:6]))
+    elif action == "register":
+        print("queued; ackdata = "
+              + rpc.call("emailGatewayRegister", argv[1], argv[2]))
+    else:
+        cmd = {"unregister": "emailGatewayUnregister",
+               "status": "emailGatewayStatus",
+               "settings": "emailGatewaySettings"}[action]
+        print("queued; ackdata = " + rpc.call(cmd, argv[1]))
+
+
+def _h_sendemail(rpc, argv):
+    sender, to_email, subject, body = argv[:4]
+    ack = rpc.call("sendEmail", sender, to_email, _b64(subject),
+                   _b64(body))
+    print(f"queued; ackdata = {ack}")
+
+
 COMMANDS: dict[str, tuple[str, int, callable]] = {
     "listaddresses": ("", 0, _h_listaddresses),
     "createaddress": ("[label]", 0, _h_createaddress),
@@ -345,7 +376,7 @@ COMMANDS: dict[str, tuple[str, int, callable]] = {
     "saveattachment": ("<msgid> [dir]", 1, _h_saveattachment),
     "broadcast": ("<from> <subject> <body>", 3, _h_broadcast),
     "inbox": ("", 0, _h_inbox),
-    "search": ("<text>", 1, _h_search),
+    "search": ("<text> [inbox|sent|trash|new] [field]", 1, _h_search),
     "sent": ("", 0, _h_sent),
     "read": ("<msgid>", 1, _h_read),
     "status": ("<ackdata>", 1, _h_status),
@@ -360,6 +391,9 @@ COMMANDS: dict[str, tuple[str, int, callable]] = {
     "chanleave": ("<address>", 1, _h_chanleave),
     "trash": ("<msgid>", 1, _h_trash),
     "clientstatus": ("", 0, _h_clientstatus),
+    "emailgateway": ("set|register|unregister|status|settings <args>", 2,
+                     _h_emailgateway),
+    "sendemail": ("<from> <to-email> <subject> <body>", 4, _h_sendemail),
     "shutdown": ("", 0, _h_shutdown),
 }
 

@@ -199,3 +199,57 @@ class Node:
     def message_status(self, ackdata: bytes) -> str:
         m = self.store.sent_by_ackdata(ackdata)
         return m.status if m else "notfound"
+
+    # -- email gateway (reference bitmessageqt/account.py:185-345) -----------
+
+    def set_email_gateway(self, address: str, gateway: str, *,
+                          registration: str = "", unregistration: str = "",
+                          relay: str = "") -> None:
+        """Mark one of our identities as registered with an email
+        gateway operator (the reference's per-address 'gateway' config
+        key); empty ``gateway`` clears it."""
+        ident = self.keystore.get(address)
+        if ident is None:
+            raise KeyError("unknown identity %s" % address)
+        ident.gateway = gateway
+        ident.gateway_registration = registration
+        ident.gateway_unregistration = unregistration
+        ident.gateway_relay = relay
+        self.keystore.save()
+
+    def _gateway_account(self, address: str):
+        from ..gateways.email_account import (EmailGatewayAccount,
+                                              spec_for_identity)
+        ident = self.keystore.get(address)
+        if ident is None:
+            raise KeyError("unknown identity %s" % address)
+        spec = spec_for_identity(ident)
+        if spec is None:
+            raise KeyError("%s is not registered with an email gateway"
+                           % address)
+        return EmailGatewayAccount(address, spec)
+
+    async def email_gateway_command(self, address: str, action: str,
+                                    email: str = "") -> bytes:
+        """Send a register/unregister/status/settings command message
+        to the identity's gateway; returns the ackdata handle."""
+        acct = self._gateway_account(address)
+        try:
+            cmd = {"register": lambda: acct.register(email),
+                   "unregister": acct.unregister,
+                   "status": acct.status,
+                   "settings": acct.settings}[action]()
+        except KeyError:
+            raise ValueError("unknown gateway action %r" % action)
+        return await self.send_message(cmd.to_address, address,
+                                       cmd.subject, cmd.body,
+                                       ttl=cmd.ttl)
+
+    async def send_email(self, from_address: str, to_email: str,
+                         subject: str, body: str) -> bytes:
+        """Send an email through the registered gateway's relay."""
+        acct = self._gateway_account(from_address)
+        cmd = acct.compose_email(to_email, subject, body)
+        return await self.send_message(cmd.to_address, from_address,
+                                       cmd.subject, cmd.body,
+                                       ttl=cmd.ttl)

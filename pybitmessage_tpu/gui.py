@@ -27,7 +27,7 @@ import sys
 from .cli import CommandError, RPCClient
 from .core.i18n import install as i18n_install, tr
 from .utils.identicon import derive
-from .viewmodel import EventPump, ViewModel, _unb64
+from .viewmodel import EventPump, SEARCH_PANES, ViewModel, _unb64
 
 #: UI tick — only checks the event pump's flag (no RPC); a real
 #: refresh happens when the long-poll delivered events, giving
@@ -61,12 +61,38 @@ class GUIController:
 
     # -- data ----------------------------------------------------------------
 
+    #: widget pane key -> ViewModel pane name (search scoping)
+    PANE_NAMES = SEARCH_PANES
+
     def refresh(self) -> bool:
         try:
             self.vm.refresh()
         except CommandError as exc:
             self.view.set_status(f"error: {exc}")
             return False
+        self._push_views()
+        return True
+
+    def search(self, pane_key: str, text: str) -> bool:
+        """Filter the current pane via the store-backed search
+        (reference Qt search bar over helper_search.search_sql);
+        empty text clears the filter."""
+        pane = self.PANE_NAMES.get(pane_key)
+        if pane is None:
+            self.view.set_status(tr("this pane is not searchable"))
+            return False
+        try:
+            hits = self.vm.search(pane, text)
+        except CommandError as exc:
+            self.view.set_status(f"error: {exc}")
+            return False
+        self._push_views()
+        self.view.set_status(
+            tr("{hits} match(es) for '{text}'", hits=hits, text=text)
+            if text else tr("filter cleared"))
+        return True
+
+    def _push_views(self) -> None:
         vm = self.vm
         self.view.fill_list("inbox", [
             (m["fromAddress"], _unb64(m["subject"])) for m in vm.inbox])
@@ -87,7 +113,6 @@ class GUIController:
         self.view.set_status(tr(
             "{inbox} inbox / {sent} sent / {mode}list mode",
             inbox=len(vm.inbox), sent=len(vm.sent), mode=vm.list_mode))
-        return True
 
     # -- messages ------------------------------------------------------------
 
@@ -232,6 +257,48 @@ class GUIController:
         """Text QR for the identity at ``index`` (qrcode plugin)."""
         return "\n".join(self.vm.qr_for(index))
 
+    # -- email gateway -------------------------------------------------------
+
+    def email_register(self, index: int, email: str) -> bool:
+        if not email or "@" not in email:
+            self.view.set_status("error: invalid email")
+            return False
+        try:
+            ack = self.vm.email_register(index, email)
+        except CommandError as exc:
+            self.view.show_error(tr("Email gateway"), str(exc))
+            return False
+        self.view.set_status("registration queued %s…" % ack[:16])
+        return self.refresh()
+
+    def email_unregister(self, index: int) -> bool:
+        try:
+            self.vm.email_unregister(index)
+        except CommandError as exc:
+            self.view.show_error(tr("Email gateway"), str(exc))
+            return False
+        self.view.set_status("unregistration queued")
+        return self.refresh()
+
+    def email_status(self, index: int) -> bool:
+        try:
+            ack = self.vm.email_status(index)
+        except CommandError as exc:
+            self.view.show_error(tr("Email gateway"), str(exc))
+            return False
+        self.view.set_status("status query queued %s…" % ack[:16])
+        return True
+
+    def email_send(self, index: int, to_email: str, subject: str,
+                   body: str) -> bool:
+        try:
+            ack = self.vm.send_email(index, to_email, subject, body)
+        except CommandError as exc:
+            self.view.show_error(tr("send failed"), str(exc))
+            return False
+        self.view.set_status("email queued %s…" % ack[:16])
+        return self.refresh()
+
     # -- settings ------------------------------------------------------------
 
     def load_settings(self) -> dict[str, str] | None:
@@ -272,7 +339,11 @@ class GUIController:
         return icon.grid, "#%02x%02x%02x" % icon.color
 
 
-class BMApp:  # pragma: no cover - thin widget shell; logic is GUIController
+class BMApp:  # pragma: no cover - widget glue; logic is GUIController.
+    # The widget layer itself is smoke-tested where an X display
+    # exists (tests/test_gui_widgets.py: construct, refresh, pane
+    # switch, search box, compose + email-gateway dialogs); this image
+    # has no X server, so that test guard-skips here.
     def __init__(self, rpc: RPCClient):
         import tkinter as tk
         from tkinter import messagebox, ttk
@@ -317,11 +388,21 @@ class BMApp:  # pragma: no cover - thin widget shell; logic is GUIController
                 (tr("Remove entry"), self._remove_entry),
                 (tr("Chan..."), self._chan_dialog),
                 (tr("QR"), self._show_qr),
+                (tr("Email gateway"), self._email_gateway_dialog),
                 (tr("Toggle mode"), self.ctl.toggle_list_mode),
                 (tr("Settings"), self._settings_dialog),
                 (tr("Refresh"), self.ctl.refresh)):
             ttk.Button(bar, text=label, command=cmd).pack(
                 side="left", padx=3, pady=4)
+        # search box filters the current pane through the store-backed
+        # search command (reference Qt search bar, helper_search.py)
+        self.search_var = tk.StringVar()
+        search_entry = ttk.Entry(bar, textvariable=self.search_var,
+                                 width=24)
+        search_entry.pack(side="left", padx=6)
+        search_entry.bind("<Return>", lambda e: self._search())
+        ttk.Button(bar, text=tr("Search"), command=self._search).pack(
+            side="left")
         self.status = tk.StringVar(value="ready")
         ttk.Label(bar, textvariable=self.status).pack(side="right", padx=6)
 
@@ -421,6 +502,9 @@ class BMApp:  # pragma: no cover - thin widget shell; logic is GUIController
     def _trash(self):
         self.ctl.trash_selected(self._selected_index(self.lists["inbox"]))
 
+    def _search(self):
+        self.ctl.search(self._current_pane(), self.search_var.get())
+
     def _compose(self):
         win = self.tk.Toplevel(self.root)
         win.title(tr("New message"))
@@ -514,6 +598,38 @@ class BMApp:  # pragma: no cover - thin widget shell; logic is GUIController
         text.pack(fill="both", expand=True)
         text.insert("1.0", self.ctl.qr_text(i))
         text.configure(state="disabled")
+
+    def _email_gateway_dialog(self):
+        """Register/unregister the selected identity with an email
+        gateway and send email through it (reference emailgateway.ui
+        + account.py flows)."""
+        i = self._selected_index(self.lists["identities"])
+        if i < 0:
+            self.set_status("select an identity first")
+            return
+        win = self.tk.Toplevel(self.root)
+        win.title(tr("Email gateway"))
+        entries = {}
+        for row, name in enumerate(("email", "to", "subject")):
+            self.ttk.Label(win, text=name).grid(row=row, column=0,
+                                                sticky="e")
+            e = self.ttk.Entry(win, width=50)
+            e.grid(row=row, column=1, padx=4, pady=2)
+            entries[name] = e
+        body = self.tk.Text(win, width=50, height=8)
+        body.grid(row=3, column=1, padx=4, pady=4)
+        bar = self.ttk.Frame(win)
+        bar.grid(row=4, column=1, sticky="e")
+        for label, cmd in (
+                (tr("Register"), lambda: self.ctl.email_register(
+                    i, entries["email"].get())),
+                (tr("Unregister"), lambda: self.ctl.email_unregister(i)),
+                (tr("Status"), lambda: self.ctl.email_status(i)),
+                (tr("Send email"), lambda: self.ctl.email_send(
+                    i, entries["to"].get(), entries["subject"].get(),
+                    body.get("1.0", "end-1c")))):
+            self.ttk.Button(bar, text=label, command=cmd).pack(
+                side="left", padx=3, pady=4)
 
     def _settings_dialog(self):
         values = self.ctl.load_settings()

@@ -1,0 +1,263 @@
+"""Mobile-style shell built mechanically from the screen registry.
+
+Role model: the reference's Kivy app constructs its whole UI from a
+declarative screen registry — ``ScreenManager`` + NavigationDrawer
+pages loaded from ``screens_data.json`` (src/bitmessagekivy/mpybit.py,
+screens_data.json).  Kivy is not installable here, so the same
+mechanics run on curses (in-image everywhere): this module holds NO
+per-screen knowledge — navigation, list/status rendering, detail
+views, forms and actions are all constructed from ``screens.json``
+via :func:`screens.bind`.  Adding a screen to the registry adds it to
+this app with zero code changes, exactly like dropping a page into
+``screens_data.json`` does in the reference.
+
+Split for testability (the gui.py/tui.py pattern):
+
+- :class:`MobileShell` — the whole navigation/interaction state
+  machine, headless:  ``render(width)`` returns plain lines,
+  ``handle_key`` / ``run_action`` / ``submit_form`` mutate state.
+  Driven screen-by-screen against a live node in
+  tests/test_mobile.py.
+- ``run()`` — the thin curses loop: paints ``render()``, forwards
+  keys, prompts for the parameter names the shell reports.
+
+Usage:  python -m pybitmessage_tpu.mobile --api-port 8442
+"""
+
+from __future__ import annotations
+
+import argparse
+import inspect
+import sys
+
+from .cli import CommandError, RPCClient
+from .core.i18n import install as i18n_install, tr
+from .screens import Screen, bind, navigation
+from .viewmodel import EventPump, ViewModel, _clip
+
+
+class MobileShell:
+    """Navigation + screen interaction over a bound screen registry."""
+
+    def __init__(self, vm: ViewModel, screens: dict[str, Screen] | None
+                 = None):
+        self.vm = vm
+        self.screens = screens if screens is not None else bind(vm)
+        self.nav = navigation(self.screens)
+        self.mode = "nav"            # nav | screen | detail | overlay
+        self.current: Screen | None = None
+        self.nav_selected = 0
+        self.selected = 0
+        self.status = tr("j/k move  Enter open  b back  q quit")
+        self.overlay: list[str] | None = None
+
+    # -- rendering -----------------------------------------------------------
+
+    def render(self, width: int = 80) -> list[str]:
+        """The full frame as plain lines (the curses loop paints these;
+        tests assert on them)."""
+        if self.mode == "overlay" and self.overlay is not None:
+            return [_clip(ln, width) for ln in self.overlay]
+        if self.mode == "nav":
+            out = [_clip("= " + tr("pybitmessage-tpu") + " =", width)]
+            for i, (_name, label) in enumerate(self.nav):
+                marker = "> " if i == self.nav_selected else "  "
+                out.append(_clip(marker + label, width))
+            return out
+        s = self.current
+        out = [_clip("[%s]" % s.label, width)]
+        if self.mode == "detail" and s.detail is not None:
+            out.extend(s.detail(self.selected, width))
+            return out
+        if s.render is not None:
+            for i, line in enumerate(s.render(width)):
+                marker = "> " if (s.kind == "list"
+                                  and i == self.selected) else "  "
+                out.append(_clip(marker + line, width))
+        if s.kind == "form":
+            out.append(_clip(tr("form fields") + ": "
+                             + ", ".join(s.form_fields), width))
+        return out
+
+    # -- navigation ----------------------------------------------------------
+
+    def open_screen(self, name: str) -> Screen:
+        self.current = self.screens[name]
+        self.mode = "screen"
+        self.selected = 0
+        return self.current
+
+    def back(self) -> None:
+        if self.mode in ("detail", "overlay"):
+            self.overlay = None
+            self.mode = "screen"
+        else:
+            self.mode = "nav"
+            self.current = None
+
+    def handle_key(self, key: str) -> bool:
+        """Mechanical key handling; returns False to quit.  Keys that
+        need text input (actions/forms) are driven by the toolkit loop
+        through :meth:`action_params` / :meth:`run_action` /
+        :meth:`submit_form` instead."""
+        if key == "q" and self.mode == "nav":
+            return False
+        if key in ("b", "\x1b"):
+            self.back()
+        elif self.mode == "nav":
+            if key == "j":
+                self.nav_selected = min(len(self.nav) - 1,
+                                        self.nav_selected + 1)
+            elif key == "k":
+                self.nav_selected = max(0, self.nav_selected - 1)
+            elif key in ("\n", "\r"):
+                self.open_screen(self.nav[self.nav_selected][0])
+        elif self.mode == "screen":
+            if key == "j":
+                self.selected += 1
+            elif key == "k":
+                self.selected = max(0, self.selected - 1)
+            elif key in ("\n", "\r") and self.current.detail is not None:
+                self.mode = "detail"
+        return True
+
+    # -- mechanical actions/forms (registry-driven) --------------------------
+
+    def action_names(self) -> list[str]:
+        return list(self.current.actions) if self.current else []
+
+    def action_params(self, name: str) -> list[str]:
+        """Parameter names the toolkit must prompt for — ``index``
+        parameters are auto-filled from the current selection, so they
+        are excluded."""
+        fn = self.current.actions[name]
+        return [p.name for p in inspect.signature(fn).parameters.values()
+                if p.name != "index"
+                and p.kind in (p.POSITIONAL_OR_KEYWORD, p.POSITIONAL_ONLY)]
+
+    def run_action(self, name: str, *prompted) -> None:
+        """Invoke a registry action: ``index`` params come from the
+        selection, everything else from ``prompted`` (in signature
+        order).  List results become an overlay (e.g. QR); scalars
+        land in the status line."""
+        fn = self.current.actions[name]
+        args, prompted = [], list(prompted)
+        for p in inspect.signature(fn).parameters.values():
+            if p.kind not in (p.POSITIONAL_OR_KEYWORD, p.POSITIONAL_ONLY):
+                continue
+            if p.name == "index":
+                args.append(self.selected)
+            elif prompted:
+                args.append(prompted.pop(0))
+            elif p.default is not p.empty:
+                args.append(p.default)
+        try:
+            result = fn(*args)
+        except (CommandError, IndexError) as exc:
+            self.status = "error: %s" % exc
+            return
+        if isinstance(result, list):
+            self.overlay = [str(ln) for ln in result]
+            self.mode = "overlay"
+        else:
+            self.status = "%s: %s" % (name, result) if result is not None \
+                else name + " ok"
+        self._refresh_quietly()
+        self.selected = 0
+
+    def submit_form(self, *values) -> None:
+        """Submit the current screen's form with ``values`` aligned to
+        ``form_fields``."""
+        try:
+            result = self.current.submit(*values)
+        except CommandError as exc:
+            self.status = "error: %s" % exc
+            return
+        self.status = str(result)
+        self._refresh_quietly()
+
+    def _refresh_quietly(self) -> None:
+        try:
+            self.vm.refresh()
+        except CommandError as exc:  # daemon restarting mid-action
+            self.status = "error: %s" % exc
+
+
+# --- curses loop ------------------------------------------------------------
+
+def run(rpc: RPCClient) -> int:  # pragma: no cover - needs a tty
+    import curses
+
+    vm = ViewModel(rpc)
+    vm.refresh()
+    shell = MobileShell(vm)
+    pump = EventPump(rpc).start()
+
+    def prompt(stdscr, label: str) -> str:
+        curses.echo()
+        stdscr.timeout(-1)
+        h, w = stdscr.getmaxyx()
+        stdscr.addstr(h - 1, 0, " " * (w - 1))
+        stdscr.addstr(h - 1, 0, label)
+        stdscr.refresh()
+        value = stdscr.getstr(h - 1, len(label), 512).decode()
+        curses.noecho()
+        stdscr.timeout(250)
+        return value
+
+    def loop(stdscr):
+        curses.curs_set(0)
+        stdscr.timeout(250)
+        while True:
+            stdscr.erase()
+            h, w = stdscr.getmaxyx()
+            for y, line in enumerate(shell.render(w)[:h - 1]):
+                stdscr.addstr(y, 0, line)
+            hints = "a action  f form  " if shell.mode == "screen" else ""
+            stdscr.addstr(h - 1, 0,
+                          _clip(hints + shell.status, w), curses.A_REVERSE)
+            stdscr.refresh()
+            key = stdscr.getch()
+            if key == -1:
+                if pump.pending():
+                    shell._refresh_quietly()
+                continue
+            ch = chr(key) if 0 < key < 256 else ""
+            if ch == "a" and shell.mode == "screen" \
+                    and shell.action_names():
+                names = shell.action_names()
+                pick = prompt(stdscr, "action (%s): " % ", ".join(names))
+                if pick in names:
+                    prompted = [prompt(stdscr, "%s: " % p)
+                                for p in shell.action_params(pick)]
+                    shell.run_action(pick, *prompted)
+            elif ch == "f" and shell.mode == "screen" \
+                    and shell.current.submit is not None:
+                values = [prompt(stdscr, "%s: " % f)
+                          for f in shell.current.form_fields]
+                shell.submit_form(*values)
+            elif not shell.handle_key(ch):
+                return 0
+
+    try:
+        return curses.wrapper(loop)
+    finally:
+        pump.stop()
+
+
+def main(argv=None) -> int:  # pragma: no cover - needs a tty
+    p = argparse.ArgumentParser(prog="pybitmessage_tpu.mobile")
+    p.add_argument("--api-host", default="127.0.0.1")
+    p.add_argument("--api-port", type=int, default=8442)
+    p.add_argument("--api-user", default="")
+    p.add_argument("--api-password", default="")
+    p.add_argument("--lang", default=None,
+                   help="UI language (e.g. 'de'); default from $LANG")
+    args = p.parse_args(argv)
+    i18n_install(args.lang)
+    return run(RPCClient(args.api_host, args.api_port, args.api_user,
+                         args.api_password))
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

@@ -16,12 +16,13 @@ suite drives every screen against a live node instead of a mock.
 
 from __future__ import annotations
 
+import functools
 import json
 from dataclasses import dataclass, field
 from pathlib import Path
 
 from .core.i18n import tr
-from .viewmodel import ViewModel
+from .viewmodel import SEARCH_PANES, ViewModel
 
 REGISTRY_PATH = Path(__file__).resolve().parent / "screens.json"
 
@@ -81,6 +82,15 @@ def bind(vm: ViewModel, path: Path | None = None) -> dict[str, Screen]:
         actions = {
             act: resolve(target, "action %r" % act, name, required=True)
             for act, target in spec.get("actions", {}).items()}
+        if "search" in actions:
+            # shells know the text, not the ViewModel pane name: curry
+            # the pane at load time so the bound action is fn(text)
+            pane = SEARCH_PANES.get(name)
+            if pane is None:
+                raise ScreenError(
+                    "screen %r declares a search action but is not a "
+                    "searchable pane" % name)
+            actions["search"] = functools.partial(actions["search"], pane)
         form = spec.get("form", {})
         screens[name] = Screen(
             name=name, title=spec.get("title", name), kind=kind,

@@ -193,6 +193,46 @@ class MessageStore:
         self._db.execute("UPDATE inbox SET read=? WHERE msgid=?",
                          (read, msgid))
 
+    #: fields a search may be restricted to (reference
+    #: helper_search.py:34-43); anything else searches all four
+    SEARCH_FIELDS = ("toaddress", "fromaddress", "subject", "message")
+
+    def search(self, folder: str, what: str, where: str | None = None,
+               unread_only: bool = False):
+        """LIKE-search messages (reference helper_search.search_sql).
+
+        ``folder``: 'inbox', 'trash', 'sent', or 'new' (= unread
+        inbox).  ``where`` restricts to one field from
+        :data:`SEARCH_FIELDS`; any other value (or None) matches the
+        concatenation of all four.  SQLite LIKE is case-insensitive
+        for ASCII, matching the reference's behavior.
+        """
+        field = where if where in self.SEARCH_FIELDS else \
+            "toaddress || fromaddress || subject || message"
+        pat = "%" + what + "%" if what else "%"
+        if folder == "sent":
+            rows = self._db.query(
+                "SELECT msgid, toaddress, toripe, fromaddress, subject,"
+                " message, ackdata, senttime, lastactiontime, sleeptill,"
+                " status, retrynumber, folder, encodingtype, ttl FROM sent"
+                " WHERE folder='sent' AND " + field + " LIKE ?"
+                " ORDER BY lastactiontime", (pat,))
+            return [self._sent_row(r) for r in rows]
+        if folder == "new":
+            folder, unread_only = "inbox", True
+        clauses = ["folder=?", field + " LIKE ?"]
+        args: list = [folder, pat]
+        if unread_only:
+            clauses.append("read=0")
+        rows = self._db.query(
+            "SELECT msgid, toaddress, fromaddress, subject, received,"
+            " message, folder, encodingtype, read, sighash FROM inbox"
+            " WHERE " + " AND ".join(clauses), tuple(args))
+        return [InboxMessage(bytes(r[0]), r[1], r[2], r[3], r[4], r[5],
+                             r[6], r[7], bool(r[8]),
+                             bytes(r[9]) if r[9] is not None else b"")
+                for r in rows]
+
     def all_sent(self) -> list[SentMessage]:
         rows = self._db.query(
             "SELECT msgid, toaddress, toripe, fromaddress, subject, message,"

@@ -36,6 +36,8 @@ def render_frame(vm: ViewModel, pane: str, selected: int, width: int,
     testable composition the curses shell paints.  ``overlay`` (e.g. a
     QR code) replaces the pane body until dismissed."""
     tabs = "  ".join(("[%s]" % p) if p == pane else p for p in PANES)
+    if vm.filter_text:
+        tabs += "   /" + vm.filter_text
     out = [_clip(tabs, width), "-" * max(width - 1, 1)]
     if overlay is not None:
         out.extend(_clip(line, width) for line in overlay)
@@ -84,7 +86,7 @@ def run(rpc: RPCClient) -> int:  # pragma: no cover - needs a tty
         last_refresh = _time.monotonic()
         status_line = "r refresh  n new  b broadcast  a address  " \
             "+ add  x del  m mode  t trash  Enter read/edit  " \
-            "c chan  C join  Q qr  M list  Tab pane  q quit"
+            "c chan  C join  Q qr  M list  / search  Tab pane  q quit"
         while True:
             stdscr.erase()
             h, w = stdscr.getmaxyx()
@@ -236,6 +238,17 @@ def run(rpc: RPCClient) -> int:  # pragma: no cover - needs a tty
                 try:
                     vm.toggle_list_mode()
                     vm.refresh()
+                except CommandError as exc:
+                    status_line = f"error: {exc}"
+            elif key == ord("/"):
+                # search the current pane (reference Qt search bar /
+                # helper_search role); empty input clears the filter
+                try:
+                    text = prompt(stdscr, "/")
+                    hits = vm.search(pane, text)
+                    selected = 0
+                    status_line = f"{hits} match(es)" if text else \
+                        "filter cleared"
                 except CommandError as exc:
                     status_line = f"error: {exc}"
             elif key == ord("r"):

@@ -1,0 +1,38 @@
+"""Bitcoin address derivation from an uncompressed pubkey.
+
+Reference behavior: src/helper_bitcoin.py:1-32 — used by the Qt client
+to recognise/derive BTC addresses from pubkeys (e.g. when validating
+pasted key material).  Base58Check over RIPEMD160(SHA256(pubkey)) with
+a one-byte version prefix (0x00 mainnet, 0x6F testnet).
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+from .base58 import b58encode_int
+from .hashes import ripemd160
+
+MAINNET_PREFIX = 0x00
+TESTNET_PREFIX = 0x6F
+
+
+def bitcoin_address_from_pubkey(pubkey: bytes, *,
+                                testnet: bool = False) -> str:
+    """Base58Check BTC address for a 65-byte uncompressed pubkey.
+
+    Raises ``ValueError`` for any other length (the reference logs and
+    returns the string "error"; a typed error is the Python-3 form).
+    """
+    if len(pubkey) != 65:
+        raise ValueError(
+            "expected a 65-byte uncompressed pubkey, got %d bytes"
+            % len(pubkey))
+    prefix = TESTNET_PREFIX if testnet else MAINNET_PREFIX
+    payload = bytes([prefix]) + ripemd160(hashlib.sha256(pubkey).digest())
+    checksum = hashlib.sha256(hashlib.sha256(payload).digest()).digest()[:4]
+    raw = payload + checksum
+    stripped = raw.lstrip(b"\x00")
+    encoded = b58encode_int(int.from_bytes(stripped, "big")) if stripped \
+        else ""
+    return "1" * (len(raw) - len(stripped)) + encoded

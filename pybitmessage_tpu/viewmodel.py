@@ -21,6 +21,14 @@ from .utils.safetext import extract_links, sanitize, sanitize_line
 PANES = ("Inbox", "Sent", "Identities", "Subscriptions", "Addressbook",
          "Blacklist", "Settings", "Network")
 
+#: widget/screen key -> searchable pane name (shared by the GUI bar,
+#: the mobile shell, and the screens registry)
+SEARCH_PANES = {
+    "inbox": "Inbox", "sent": "Sent", "identities": "Identities",
+    "subscriptions": "Subscriptions", "addressbook": "Addressbook",
+    "blacklist": "Blacklist",
+}
+
 
 class EventPump:
     """Background ``waitForEvents`` long-poller for frontends.
@@ -100,12 +108,19 @@ class ViewModel:
         self.list_mode: str = "black"
         self.settings: dict = {}
         self.status: dict = {}
+        self.filter_text: str = ""
+        self.filter_pane: str = ""
 
     def refresh(self) -> None:
-        self.inbox = json.loads(
-            self.rpc.call("getAllInboxMessages"))["inboxMessages"]
-        self.sent = json.loads(
-            self.rpc.call("getAllSentMessages"))["sentMessages"]
+        # the filtered message pane is fetched once via searchMessages
+        # in _apply_filter — fetching the full pane here too would just
+        # be discarded (doubled RPC + body decode on every repaint)
+        if self.filter_pane != "Inbox":
+            self.inbox = json.loads(
+                self.rpc.call("getAllInboxMessages"))["inboxMessages"]
+        if self.filter_pane != "Sent":
+            self.sent = json.loads(
+                self.rpc.call("getAllSentMessages"))["sentMessages"]
         self.addresses = json.loads(
             self.rpc.call("listAddresses"))["addresses"]
         self.subscriptions = json.loads(
@@ -118,10 +133,71 @@ class ViewModel:
             self.rpc.call("listWhitelistEntries"))["whitelist"]
         self.list_mode = self.rpc.call("getBlackWhitelistMode")
         self.status = json.loads(self.rpc.call("clientStatus"))
+        self._apply_filter()
 
     def refresh_settings(self) -> None:
         """Settings fetched on demand (the dialog), not every poll."""
         self.settings = json.loads(self.rpc.call("getSettings"))
+
+    # -- search (reference helper_search.py, used by Qt + curses) ------------
+
+    def search(self, pane: str, text: str) -> int:
+        """Filter ``pane`` to rows matching ``text``; returns the hit
+        count.  Inbox/Sent route through the store-backed
+        ``searchMessages`` command (the reference's search_sql); list
+        panes filter their fetched rows on address/label.  An empty
+        ``text`` clears the filter.  The filter persists across
+        :meth:`refresh` until cleared so long-poll refreshes don't
+        silently un-filter the pane the user is looking at.  Searching
+        a non-searchable pane (Settings, Network) raises
+        :class:`CommandError` so every frontend gets the same guard.
+        """
+        if text and pane not in SEARCH_PANES.values():
+            raise CommandError(tr("this pane is not searchable"))
+        self.filter_text = text
+        self.filter_pane = pane if text else ""
+        self.refresh()
+        return len({
+            "Inbox": self.inbox, "Sent": self.sent,
+            "Identities": self.addresses,
+            "Subscriptions": self.subscriptions,
+            "Addressbook": self.addressbook,
+            "Blacklist": self.active_list,
+        }.get(pane, []))
+
+    def clear_search(self) -> None:
+        self.search(self.filter_pane or "Inbox", "")
+
+    def _apply_filter(self) -> None:
+        pane, text = self.filter_pane, self.filter_text
+        if not text:
+            return
+        if pane == "Inbox":
+            self.inbox = json.loads(self.rpc.call(
+                "searchMessages", text, "inbox"))["inboxMessages"]
+            return
+        if pane == "Sent":
+            self.sent = json.loads(self.rpc.call(
+                "searchMessages", text, "sent"))["sentMessages"]
+            return
+        needle = text.lower()
+
+        def hit(row: dict, b64label: bool) -> bool:
+            label = _unb64(row["label"]) if b64label else \
+                str(row.get("label", ""))
+            return needle in row["address"].lower() \
+                or needle in label.lower()
+
+        if pane == "Identities":
+            self.addresses = [a for a in self.addresses if hit(a, False)]
+        elif pane == "Subscriptions":
+            self.subscriptions = [s for s in self.subscriptions
+                                  if hit(s, True)]
+        elif pane == "Addressbook":
+            self.addressbook = [e for e in self.addressbook if hit(e, True)]
+        elif pane == "Blacklist":
+            self.blacklist = [e for e in self.blacklist if hit(e, True)]
+            self.whitelist = [e for e in self.whitelist if hit(e, True)]
 
     # -- renderers (pure) ----------------------------------------------------
 
@@ -356,6 +432,47 @@ class ViewModel:
         self.rpc.call("setMailingList", row["address"], enable,
                       _b64(name) if (enable and name) else "")
         return enable
+
+    # -- email gateway (reference bitmessageqt/account.py flows) -------------
+
+    def _identity_address(self, index: int) -> str:
+        if not (0 <= index < len(self.addresses)):
+            raise CommandError(tr("no identity selected"))
+        return self.addresses[index]["address"]
+
+    def email_register(self, index: int, email: str,
+                       gateway: str = "mailchuck") -> str:
+        """Register the selected identity with an email gateway and
+        request ``email`` from it; returns the ackdata handle.  If the
+        register call fails the gateway config is rolled back so the
+        processor never rewrites relay mail for an account that never
+        registered."""
+        addr = self._identity_address(index)
+        self.rpc.call("setEmailGateway", addr, gateway)
+        try:
+            return self.rpc.call("emailGatewayRegister", addr, email)
+        except CommandError:
+            try:
+                self.rpc.call("setEmailGateway", addr, "")
+            except CommandError:
+                pass        # daemon unreachable; surface the root error
+            raise
+
+    def email_unregister(self, index: int) -> str:
+        """Send the unregistration command, then clear the gateway."""
+        addr = self._identity_address(index)
+        ack = self.rpc.call("emailGatewayUnregister", addr)
+        self.rpc.call("setEmailGateway", addr, "")
+        return ack
+
+    def email_status(self, index: int) -> str:
+        return self.rpc.call("emailGatewayStatus",
+                             self._identity_address(index))
+
+    def send_email(self, index: int, to_email: str, subject: str,
+                   body: str) -> str:
+        return self.rpc.call("sendEmail", self._identity_address(index),
+                             to_email, _b64(subject), _b64(body))
 
     def qr_for(self, index: int) -> list[str]:
         """Text-QR overlay lines for the selected identity (the shipped

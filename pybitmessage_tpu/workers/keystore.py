@@ -46,6 +46,15 @@ class OwnIdentity:
     #: 'mailinglist'/'mailinglistname' per-address config keys)
     mailinglist: bool = False
     mailinglistname: str = ""
+    #: email-gateway registration: the reference stores a per-address
+    #: 'gateway' key in keys.dat naming the operator (account.py:77-85,
+    #: 228-229).  The three service addresses default to the named
+    #: operator's published ones; overrides let tests (and other
+    #: operators) point at their own nodes.
+    gateway: str = ""
+    gateway_registration: str = ""
+    gateway_unregistration: str = ""
+    gateway_relay: str = ""
 
     @property
     def pub_signing_key(self) -> bytes:
@@ -168,6 +177,10 @@ class KeyStore:
                 "lastpubkeysendtime": str(ident.last_pubkey_send_time),
                 "mailinglist": str(ident.mailinglist).lower(),
                 "mailinglistname": ident.mailinglistname,
+                "gateway": ident.gateway,
+                "gatewayregistration": ident.gateway_registration,
+                "gatewayunregistration": ident.gateway_unregistration,
+                "gatewayrelay": ident.gateway_relay,
             }
         if self.subscriptions:
             cfg["subscriptions"] = {
@@ -221,7 +234,11 @@ class KeyStore:
                 s.get("enabled", "true") == "true",
                 int(s.get("lastpubkeysendtime", 0)),
                 s.get("mailinglist", "false") == "true",
-                s.get("mailinglistname", ""))
+                s.get("mailinglistname", ""),
+                gateway=s.get("gateway", ""),
+                gateway_registration=s.get("gatewayregistration", ""),
+                gateway_unregistration=s.get("gatewayunregistration", ""),
+                gateway_relay=s.get("gatewayrelay", ""))
             self._index(ident)
 
     def touch_pubkey_sent(self, address: str) -> None:

@@ -16,6 +16,9 @@ import time
 
 from ..crypto import decrypt, verify
 from ..crypto.ecies import DecryptionError
+from ..gateways.email_account import (
+    ALL_OK, REGISTRATION_DENIED, EmailGatewayAccount, spec_for_identity,
+)
 from ..models import msgcoding
 from ..models.constants import (
     DEFAULT_EXTRA_BYTES, DEFAULT_NONCE_TRIALS_PER_BYTE, OBJECT_BROADCAST,
@@ -325,18 +328,38 @@ class ObjectProcessor:
                         from_address, self.list_mode)
             return
         body = msgcoding.decode_message(plain.message, plain.encoding)
+        subject = body.subject
+        display_from = from_address
+        # email-gateway accounts: mail arriving via the operator's
+        # relay is rewritten to its real sender/subject, and a denial
+        # from the registration address is surfaced to every frontend
+        # (reference rewrites at display time, account.py:316-345;
+        # doing it at delivery covers API/CLI consumers too)
+        gw_spec = spec_for_identity(match)
+        feedback = ALL_OK
+        if gw_spec is not None:
+            acct = EmailGatewayAccount(match.address, gw_spec)
+            display_from, subject, feedback = acct.parse_incoming(
+                from_address, subject)
         if not self.store.deliver_inbox(
                 msgid=inventory_hash(payload), toaddress=match.address,
-                fromaddress=from_address, subject=body.subject,
+                fromaddress=display_from, subject=subject,
                 message=body.body, encoding=plain.encoding,
                 sighash=sighash):
             logger.debug("duplicate message dropped (sighash)")
             return
-        logger.info("message delivered: %s -> %s", from_address,
+        # denial surfaced only for the first (non-duplicate) delivery —
+        # a gateway retry must not re-notify every frontend
+        if feedback == REGISTRATION_DENIED:
+            logger.warning("email gateway DENIED registration of %s",
+                           match.address)
+            self.ui_signal("emailGatewayRegistrationDenied",
+                           (match.address, gw_spec.name))
+        logger.info("message delivered: %s -> %s", display_from,
                     match.address)
         self.ui_signal("displayNewInboxMessage",
                        (inventory_hash(payload), match.address,
-                        from_address, body.subject, body.body))
+                        display_from, subject, body.body))
         # mailing-list identities re-send what they receive as a
         # broadcast to their subscribers (objectProcessor.py:688-721)
         if match.mailinglist and plain.encoding != 0:

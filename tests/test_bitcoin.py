@@ -1,0 +1,30 @@
+"""BTC-address-from-pubkey helper (reference src/helper_bitcoin.py)."""
+
+import pytest
+
+from pybitmessage_tpu.utils.bitcoin import bitcoin_address_from_pubkey
+
+# Classic secp256k1 test vector (Bitcoin wiki "Technical background of
+# version 1 Bitcoin addresses"): uncompressed pubkey -> P2PKH address.
+PUBKEY = bytes.fromhex(
+    "0450863AD64A87AE8A2FE83C1AF1A8403CB53F53E486D8511DAD8A04887E5B2352"
+    "2CD470243453A299FA9E77237716103ABC11A1DF38855ED6F2EE187E9C582BA6")
+
+
+def test_mainnet_golden_vector():
+    assert bitcoin_address_from_pubkey(PUBKEY) == \
+        "16UwLL9Risc3QfPqBUvKofHmBQ7wMtjvM"
+
+
+def test_testnet_prefix():
+    addr = bitcoin_address_from_pubkey(PUBKEY, testnet=True)
+    # testnet P2PKH addresses start with m or n (version byte 0x6F)
+    assert addr[0] in "mn"
+    assert len(addr) >= 26
+
+
+def test_rejects_wrong_length():
+    with pytest.raises(ValueError):
+        bitcoin_address_from_pubkey(PUBKEY[:64])
+    with pytest.raises(ValueError):
+        bitcoin_address_from_pubkey(b"")

@@ -157,6 +157,61 @@ async def test_cli_search():
     assert out.count("\n") == 2  # both messages match, one line each
     assert "(no matches)" in await _run(rpc, "search", ["zzz-nothing"])
 
+    # field restriction: only one message has needle in its SUBJECT
+    out = await _run(rpc, "search", ["needle", "inbox", "subject"])
+    assert out.count("\n") == 1
+    # sent folder search goes through the same store query
+    out = await _run(rpc, "search", ["needle subject", "sent"])
+    assert "needle subject" in out
+
+
+@pytest.mark.asyncio
+async def test_viewmodel_search_filters_and_persists():
+  async with live_api() as (node, rpc):
+    vm = ViewModel(rpc)
+    addr = await asyncio.to_thread(vm.create_address, "searcher")
+    await asyncio.to_thread(vm.send_message, addr, addr,
+                            "alpha subject", "body one")
+    await asyncio.to_thread(vm.send_message, addr, addr,
+                            "beta subject", "body two")
+    for _ in range(400):
+        if len(node.store.inbox()) == 2:
+            break
+        await asyncio.sleep(0.05)
+
+    # store-backed inbox search
+    hits = await asyncio.to_thread(vm.search, "Inbox", "alpha")
+    assert hits == 1
+    assert len(vm.inbox) == 1
+    assert "alpha subject" in vm.render_inbox(120)[0]
+    # the filter survives a refresh (event-pump repaint must not
+    # silently unfilter the pane)
+    await asyncio.to_thread(vm.refresh)
+    assert len(vm.inbox) == 1
+    # the frame header shows the active filter
+    frame = render_frame(vm, "Inbox", 0, 120)
+    assert "/alpha" in frame[0]
+
+    # sent search
+    hits = await asyncio.to_thread(vm.search, "Sent", "beta")
+    assert hits >= 1
+    assert all("beta" in _b64dec(m["subject"]) for m in vm.sent)
+
+    # list-pane client filter: identities by label
+    await asyncio.to_thread(vm.search, "Identities", "searcher")
+    assert len(vm.addresses) == 1
+    assert (await asyncio.to_thread(vm.search, "Identities",
+                                    "zz-no-such")) == 0
+    assert vm.addresses == []
+
+    # clearing restores everything
+    await asyncio.to_thread(vm.clear_search)
+    assert len(vm.inbox) == 2 and len(vm.addresses) == 1
+
+
+def _b64dec(s):
+    return base64.b64decode(s).decode("utf-8", "replace")
+
 
 def test_attachment_markup_roundtrip(tmp_path):
     """encode_attachment emits the reference's inline markup and

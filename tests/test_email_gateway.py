@@ -1,0 +1,209 @@
+"""Email-gateway account flows (reference bitmessageqt/account.py
+:185-345) — unit tests for the command/parse logic plus the VERDICT r4
+#3 "Done" criterion: a two-node dance where a scripted gateway node
+answers the registration request, denies it, and relays inbound email.
+"""
+
+import asyncio
+import time
+
+import pytest
+
+from pybitmessage_tpu.core import Node
+from pybitmessage_tpu.gateways.email_account import (
+    ALL_OK, DENIED_SUBJECT, MAILCHUCK, REGISTRATION_DENIED, Command,
+    EmailGatewayAccount, GatewaySpec, spec_for_identity,
+)
+from pybitmessage_tpu.ops import solve
+from pybitmessage_tpu.storage import Peer
+
+
+def _test_solver(initial_hash, target, should_stop=None):
+    return solve(initial_hash, target, lanes=4096, chunks_per_call=16,
+                 should_stop=should_stop)
+
+
+def _make_node(**kw):
+    return Node(listen=kw.pop("listen", True), solver=_test_solver,
+                test_mode=True, allow_private_peers=True,
+                dandelion_enabled=False, **kw)
+
+
+async def _wait_for(predicate, timeout=60.0, interval=0.1):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if predicate():
+            return True
+        await asyncio.sleep(interval)
+    return False
+
+
+# -- pure logic ---------------------------------------------------------------
+
+def test_command_messages_match_reference_shapes():
+    a = EmailGatewayAccount("BM-me")
+    assert a.register("me@example.com") == Command(
+        MAILCHUCK.registration, "me@example.com", "")
+    assert a.unregister() == Command(MAILCHUCK.unregistration, "", "")
+    assert a.status() == Command(MAILCHUCK.registration, "status", "")
+    cfg = a.settings()
+    assert cfg.to_address == MAILCHUCK.registration
+    assert cfg.subject == "config"
+    # the gateway's parse surface: every documented option key present
+    for key in ("pgp:", "attachments:", "archive:", "masterpubkey_btc:",
+                "offset_btc:", "feeamount:", "feecurrency:"):
+        assert key in cfg.body
+    # command messages are short-lived (TTL capped at 2 days)
+    assert cfg.ttl == 2 * 86400
+
+
+def test_relay_roundtrip_and_denial_parse():
+    a = EmailGatewayAccount("BM-me")
+    out = a.compose_email("bob@example.com", "Hi Bob", "body")
+    assert out.to_address == MAILCHUCK.relay
+    assert out.subject == "bob@example.com Hi Bob"
+    # what the gateway does with it
+    assert EmailGatewayAccount.parse_outgoing(out.subject) == \
+        ("bob@example.com", "Hi Bob")
+
+    # incoming relay mail rewrites to the real sender
+    frm, subj, fb = a.parse_incoming(
+        MAILCHUCK.relay, "MAILCHUCK-FROM::alice@example.com | Hello")
+    assert (frm, subj, fb) == ("alice@example.com", "Hello", ALL_OK)
+    # relay mail without the marker is untouched
+    frm, subj, fb = a.parse_incoming(MAILCHUCK.relay, "plain subject")
+    assert (frm, subj, fb) == (MAILCHUCK.relay, "plain subject", ALL_OK)
+    # denial only matches the registration address + exact subject
+    _, _, fb = a.parse_incoming(MAILCHUCK.registration, DENIED_SUBJECT)
+    assert fb == REGISTRATION_DENIED
+    _, _, fb = a.parse_incoming("BM-other", DENIED_SUBJECT)
+    assert fb == ALL_OK
+
+
+def test_spec_resolution_from_identity_config():
+    class FakeIdent:
+        gateway = "mailchuck"
+        gateway_registration = ""
+        gateway_unregistration = ""
+        gateway_relay = "BM-overridden-relay"
+
+    spec = spec_for_identity(FakeIdent())
+    assert spec.registration == MAILCHUCK.registration
+    assert spec.relay == "BM-overridden-relay"
+
+    FakeIdent.gateway = ""
+    assert spec_for_identity(FakeIdent()) is None
+
+    # unknown operator: overrides are the only addresses
+    FakeIdent.gateway = "other"
+    spec = spec_for_identity(FakeIdent())
+    assert spec.name == "other" and spec.registration == ""
+
+
+def test_gateway_config_roundtrips_through_keys_dat(tmp_path):
+    """The per-address gateway keys persist like the reference's
+    'gateway' option in keys.dat (account.py:228-229)."""
+    from pybitmessage_tpu.workers.keystore import KeyStore
+
+    ks = KeyStore(tmp_path / "keys.dat")
+    ident = ks.create_random("gw id")
+    ident.gateway = "mailchuck"
+    ident.gateway_relay = "BM-customrelay"
+    ks.save()
+
+    ks2 = KeyStore(tmp_path / "keys.dat")
+    back = ks2.get(ident.address)
+    assert back.gateway == "mailchuck"
+    assert back.gateway_relay == "BM-customrelay"
+    spec = spec_for_identity(back)
+    assert spec.registration == MAILCHUCK.registration
+    assert spec.relay == "BM-customrelay"
+
+
+# -- the two-node registration dance -----------------------------------------
+
+@pytest.mark.asyncio
+async def test_two_node_gateway_registration_denial_and_relay():
+    """User node registers with a scripted gateway node; the gateway
+    sees the request, denies it (flagged to the UI event stream), and
+    later relays an inbound email that the user's processor rewrites
+    for display.  Outgoing email rides the relay with the recipient in
+    the subject."""
+    user = _make_node()
+    gw = _make_node()
+    await user.start()
+    await gw.start()
+    try:
+        me = user.create_identity("me")
+        gw_reg = gw.create_identity("gateway registration")
+        gw_relay = gw.create_identity("gateway relay")
+
+        conn = await gw.pool.connect_to(
+            Peer("127.0.0.1", user.pool.listen_port))
+        assert await _wait_for(lambda: conn.fully_established)
+
+        # configure the account against the scripted operator
+        with pytest.raises(KeyError):
+            user.set_email_gateway("BM-nonexistent", "x")
+        user.set_email_gateway(
+            me.address, "testgw",
+            registration=gw_reg.address,
+            unregistration=gw_reg.address,
+            relay=gw_relay.address)
+        spec = spec_for_identity(user.keystore.get(me.address))
+        assert spec == GatewaySpec("testgw", gw_reg.address,
+                                   gw_reg.address, gw_relay.address)
+
+        denied = []
+        user.ui.subscribe(
+            lambda cmd, data: denied.append(data)
+            if cmd == "emailGatewayRegistrationDenied" else None)
+
+        # 1. register: the command message reaches the gateway with
+        # the requested email as its subject
+        await user.email_gateway_command(me.address, "register",
+                                         email="me@example.com")
+        assert await _wait_for(
+            lambda: len(gw.store.inbox()) > 0, timeout=180), \
+            "registration request never reached the gateway"
+        req = gw.store.inbox()[0]
+        assert req.subject == "me@example.com"
+        assert req.toaddress == gw_reg.address
+        assert req.fromaddress == me.address
+
+        # 2. the gateway denies: the user's processor flags it
+        await gw.send_message(me.address, gw_reg.address,
+                              DENIED_SUBJECT, "", ttl=300)
+        assert await _wait_for(lambda: denied, timeout=180), \
+            "denial never surfaced on the UI event stream"
+        assert denied[0] == (me.address, "testgw")
+
+        # 3. the gateway relays an inbound email; the user sees the
+        # real sender and subject, not the relay markup
+        await gw.send_message(
+            me.address, gw_relay.address,
+            "MAILCHUCK-FROM::carol@example.com | Lunch?", "see you at 12",
+            ttl=300)
+        assert await _wait_for(
+            lambda: any(m.fromaddress == "carol@example.com"
+                        for m in user.store.inbox()), timeout=180), \
+            "relayed email never rewritten into the inbox"
+        mail = [m for m in user.store.inbox()
+                if m.fromaddress == "carol@example.com"][0]
+        assert mail.subject == "Lunch?"
+        assert mail.message == "see you at 12"
+
+        # 4. outgoing email rides the relay, recipient in the subject
+        await user.send_email(me.address, "dave@example.com",
+                              "Re: Lunch?", "12 works")
+        assert await _wait_for(
+            lambda: any(m.toaddress == gw_relay.address
+                        for m in gw.store.inbox()), timeout=180), \
+            "outgoing email never reached the relay"
+        out = [m for m in gw.store.inbox()
+               if m.toaddress == gw_relay.address][0]
+        assert EmailGatewayAccount.parse_outgoing(out.subject) == \
+            ("dave@example.com", "Re: Lunch?")
+    finally:
+        await gw.stop()
+        await user.stop()

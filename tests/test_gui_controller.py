@@ -96,6 +96,64 @@ async def test_identity_send_read_trash_flow():
 
 
 @pytest.mark.asyncio
+async def test_search_filters_current_pane():
+  async with live_controller() as (node, ctl, view):
+    assert await asyncio.to_thread(ctl.create_identity, "gui id")
+    addr = view.lists["identities"][0][0]
+    assert await asyncio.to_thread(ctl.send, addr, addr, "findme subj",
+                                   "haystack")
+    assert await asyncio.to_thread(ctl.send, addr, addr, "other subj",
+                                   "haystack")
+    for _ in range(400):
+        if len(node.store.inbox()) == 2:
+            break
+        await asyncio.sleep(0.05)
+    assert await asyncio.to_thread(ctl.refresh)
+    assert len(view.lists["inbox"]) == 2
+
+    # store-backed inbox search narrows the pane
+    assert await asyncio.to_thread(ctl.search, "inbox", "findme")
+    assert view.lists["inbox"] == [(addr, "findme subj")]
+    assert any("match" in s for s in view.status)
+
+    # clearing restores; unknown pane is a clean no-op
+    assert await asyncio.to_thread(ctl.search, "inbox", "")
+    assert len(view.lists["inbox"]) == 2
+    assert not await asyncio.to_thread(ctl.search, "network", "x")
+
+
+@pytest.mark.asyncio
+async def test_email_gateway_controller_flows():
+  async with live_controller() as (node, ctl, view):
+    assert await asyncio.to_thread(ctl.create_identity, "gw id")
+    # status on an unregistered identity -> error dialog, never a crash
+    assert not await asyncio.to_thread(ctl.email_status, 0)
+    assert any("Email gateway" in e[0] for e in view.errors)
+    # invalid email rejected client-side
+    assert not await asyncio.to_thread(ctl.email_register, 0, "nope")
+
+    # register configures the gateway and queues the command message
+    assert await asyncio.to_thread(ctl.email_register, 0, "me@x.com")
+    ident = list(node.keystore.identities.values())[0]
+    assert ident.gateway == "mailchuck"
+    assert await asyncio.to_thread(ctl.email_status, 0)
+    assert await asyncio.to_thread(ctl.email_send, 0, "bob@x.com",
+                                   "subj", "body")
+    # the relay-bound message carries the recipient in its subject
+    from pybitmessage_tpu.gateways.email_account import (
+        MAILCHUCK, EmailGatewayAccount)
+    relay_msgs = [m for m in node.store.sent_by_status(
+        "msgqueued", "doingpubkeypow", "awaitingpubkey", "doingmsgpow")
+        if m.toaddress == MAILCHUCK.relay]
+    assert relay_msgs
+    assert EmailGatewayAccount.parse_outgoing(relay_msgs[0].subject) \
+        == ("bob@x.com", "subj")
+
+    assert await asyncio.to_thread(ctl.email_unregister, 0)
+    assert ident.gateway == ""
+
+
+@pytest.mark.asyncio
 async def test_send_error_surfaces_as_dialog():
   async with live_controller() as (node, ctl, view):
     assert not await asyncio.to_thread(ctl.send, "not-an-address",

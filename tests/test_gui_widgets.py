@@ -1,0 +1,110 @@
+"""Real-widget smoke test for the tkinter shell (VERDICT r4 #6).
+
+Everything with behavior lives in the headless-tested GUIController;
+this exercises the ~300 widget-glue lines of BMApp itself: construct
+the real Tk window against a live node, refresh (fills every
+Treeview/Text through the view protocol), switch panes, run a search
+through the real entry box, and open the compose + email-gateway
+dialogs.
+
+Needs an X display (Xvfb suffices).  This image ships neither an X
+server nor Xvfb, so the test guard-skips here and runs wherever a
+display exists — the same posture as the reference's Kivy/telenium
+suite, which only runs in its Docker rig.
+"""
+
+import asyncio
+from contextlib import asynccontextmanager
+
+import pytest
+
+from pybitmessage_tpu.api import APIServer
+from pybitmessage_tpu.cli import RPCClient
+from pybitmessage_tpu.core import Node
+
+
+def _display_available() -> bool:
+    try:
+        import tkinter
+        root = tkinter.Tk()
+        root.destroy()
+        return True
+    except Exception:
+        return False
+
+
+requires_display = pytest.mark.skipif(
+    not _display_available(),
+    reason="tkinter needs an X display (install/run under Xvfb)")
+
+
+def _solver(ih, t, should_stop=None):
+    from pybitmessage_tpu.pow.dispatcher import python_solve
+    return python_solve(ih, t, should_stop=should_stop)
+
+
+@asynccontextmanager
+async def live_rpc():
+    node = Node(listen=False, solver=_solver, test_mode=True,
+                tls_enabled=False)
+    await node.start()
+    api = APIServer(node, port=0, username="u", password="p")
+    await api.start()
+    try:
+        yield node, RPCClient(port=api.listen_port, user="u", password="p")
+    finally:
+        await api.stop()
+        await node.stop()
+
+
+@requires_display
+@pytest.mark.asyncio
+async def test_bmapp_constructs_refreshes_and_opens_dialogs():
+  async with live_rpc() as (node, rpc):
+    from pybitmessage_tpu.gui import BMApp
+
+    def drive():
+        app = BMApp(rpc)
+        try:
+            # constructor built every pane in registry order
+            assert set(app.lists) == {"inbox", "sent", "identities",
+                                      "subscriptions", "addressbook",
+                                      "blacklist"}
+            assert "network" in app.texts
+
+            # a real refresh fills the real widgets
+            assert app.ctl.refresh()
+            app.root.update()
+            assert app.status.get().startswith("0 inbox")
+
+            # create an identity, refresh shows it in the Treeview
+            assert app.ctl.create_identity("widget id")
+            app.root.update()
+            tree = app.lists["identities"]
+            assert len(tree.get_children()) == 1
+
+            # pane switch + search through the real entry box
+            app.notebook.select(2)          # identities pane
+            app.root.update()
+            app.search_var.set("widget")
+            app._search()
+            app.root.update()
+            assert len(tree.get_children()) == 1
+            app.search_var.set("zz-none")
+            app._search()
+            app.root.update()
+            assert len(tree.get_children()) == 0
+            app.search_var.set("")
+            app._search()
+            app.root.update()
+
+            # compose + email-gateway dialogs open (Toplevels build)
+            app._compose()
+            tree.selection_set(tree.get_children()[0])
+            app._email_gateway_dialog()
+            app.root.update()
+            assert len(app.root.winfo_children()) >= 3  # 2 dialogs + main
+        finally:
+            app.root.destroy()
+
+    await asyncio.to_thread(drive)

@@ -88,8 +88,29 @@ def test_catalogs_cover_the_full_tr_surface():
         surface.update(re.findall(r'\btr\(\s*"((?:[^"\\]|\\.)+)"',
                                   py.read_text()))
     assert len(surface) >= 40, "tr() surface scan looks broken"
-    for lang in ("de", "fr"):
+    # the registry's screen titles reach tr() as variables
+    # (Screen.label) — they are part of the surface too
+    import json
+    registry = json.loads((pkg / "screens.json").read_text())
+    surface.update(spec["title"] for name, spec in registry.items()
+                   if not name.startswith("_"))
+    shipped = sorted(p.stem for p in (pkg / "locale").glob("*.po"))
+    assert shipped == ["de", "es", "fr", "it", "ja", "ru"]
+    for lang in shipped:
         catalog = i18n.parse_po(
             (pkg / "locale" / f"{lang}.po").read_text())
         missing = {s for s in surface if s not in catalog}
         assert not missing, f"{lang}.po missing: {sorted(missing)}"
+
+
+def test_new_catalogs_roundtrip():
+    """es/it/ja/ru load and actually translate (VERDICT r4 #7)."""
+    for lang, inbox in (("es", "Bandeja de entrada"),
+                        ("it", "Posta in arrivo"),
+                        ("ja", "受信箱"),
+                        ("ru", "Входящие")):
+        assert i18n.install(lang) == lang
+        assert i18n.tr("Inbox") == inbox
+        assert i18n.tr("No such key 123") == "No such key 123"
+        # placeholder strings survive translation + interpolation
+        assert "7" in i18n.tr("Connections: {count}", count=7)

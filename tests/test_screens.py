@@ -102,9 +102,16 @@ async def test_screens_drive_live_node():
         if s.render is not None:
             assert s.render(80)
 
-    # inbox detail + trash action
+    # inbox detail + search + trash actions
     detail = await asyncio.to_thread(screens["inbox"].detail, 0, 60)
     assert any("mob body" in ln for ln in detail)
+    hits = await asyncio.to_thread(
+        screens["inbox"].actions["search"], "mob subj")
+    assert hits == 1 and len(vm.inbox) == 1
+    assert await asyncio.to_thread(
+        screens["inbox"].actions["search"], "zz-none") == 0
+    assert vm.inbox == []
+    await asyncio.to_thread(screens["inbox"].actions["search"], "")
     await asyncio.to_thread(screens["inbox"].actions["trash"], 0)
     await asyncio.to_thread(vm.refresh)
     assert vm.inbox == []

@@ -151,6 +151,36 @@ def test_message_store_inbox_dedup(db):
     assert len(ms.inbox(include_trash=True)) == 1
 
 
+def test_message_store_search(db):
+    """LIKE search over inbox/sent (reference helper_search.search_sql)."""
+    ms = MessageStore(db)
+    ms.deliver_inbox(msgid=b"s1", toaddress="BM-a", fromaddress="BM-b",
+                     subject="Alpha Report", message="the quick fox")
+    ms.deliver_inbox(msgid=b"s2", toaddress="BM-a", fromaddress="BM-c",
+                     subject="beta", message="lazy dog fox")
+    ms.mark_read(b"s1")
+    ms.queue_sent(msgid=b"s3", toaddress="BM-d", toripe=b"r" * 20,
+                  fromaddress="BM-a", subject="outgoing alpha",
+                  message="sent body", ackdata=b"A" * 32, ttl=3600)
+    db.execute("UPDATE sent SET folder='sent'")
+
+    # case-insensitive, any-field by default
+    assert {m.msgid for m in ms.search("inbox", "ALPHA")} == {b"s1"}
+    assert {m.msgid for m in ms.search("inbox", "fox")} == {b"s1", b"s2"}
+    # field restriction
+    assert ms.search("inbox", "fox", where="subject") == []
+    assert {m.msgid for m in ms.search("inbox", "BM-c",
+                                       where="fromaddress")} == {b"s2"}
+    # 'new' = unread inbox only
+    assert {m.msgid for m in ms.search("new", "fox")} == {b"s2"}
+    # sent folder
+    assert [m.msgid for m in ms.search("sent", "alpha")] == [b"s3"]
+    # a bogus where-field falls back to all-fields, never raw SQL
+    assert {m.msgid for m in ms.search("inbox", "fox",
+                                       where="1=1; DROP TABLE inbox")} \
+        == {b"s1", b"s2"}
+
+
 def test_message_store_interrupted_pow_reset(db):
     ms = MessageStore(db)
     ms.queue_sent(msgid=b"m", toaddress="t", toripe=b"", fromaddress="f",

@@ -21,12 +21,12 @@ API_USER, API_PASS = "ptyuser", "ptypass"
 
 
 class TuiSession:
-    def __init__(self, api_port):
+    def __init__(self, api_port, module="pybitmessage_tpu.tui"):
         self.master, slave = pty.openpty()
         os.set_blocking(self.master, False)
         env = dict(DAEMON_ENV, TERM="xterm", LINES="40", COLUMNS="120")
         self.proc = subprocess.Popen(
-            [sys.executable, "-m", "pybitmessage_tpu.tui",
+            [sys.executable, "-m", module,
              "--api-port", str(api_port),
              "--api-user", API_USER, "--api-password", API_PASS],
             stdin=slave, stdout=slave, stderr=subprocess.DEVNULL,
