@@ -276,15 +276,24 @@ class CommandHandler:
         passphrase_raw = _from_b64(passphrase, 1)
         if not passphrase_raw:
             raise APIError(1)
-        decode_address(address)
+        a = decode_address(address)
+        if a.version not in (2, 3, 4):
+            raise APIError(2)
         if self.node.keystore.owns(address):
             raise APIError(24)
-        ident = self.node.keystore.create_deterministic(
-            passphrase_raw.encode("utf-8"), f"[chan] {passphrase_raw}",
-            chan=True)
-        if ident.address != address:
-            # keystore now contains the derived address; report mismatch
+        # derive FIRST, register only on a match — a mismatch must not
+        # leave a stray derived identity in the keystore (the reference
+        # validator does this check pre-registration too,
+        # bitmessageqt/addressvalidator.py).  RIPE-byte comparison, not
+        # string equality: decode tolerates a missing BM- prefix.
+        from ..crypto import grind_deterministic_keys
+        sk, ek, ripe, _ = grind_deterministic_keys(
+            passphrase_raw.encode("utf-8"))
+        if a.ripe != ripe:
             raise APIError(18)
+        self.node.keystore._register(
+            f"[chan] {passphrase_raw}", a.version, a.stream, ripe, sk, ek,
+            chan=True)
         return "success"
 
     def cmd_leaveChan(self, address):

@@ -219,6 +219,10 @@ class GUIController:
     def chan_create(self, passphrase: str | None) -> bool:
         if not passphrase:
             return False
+        err = self.vm.validate_chan(passphrase)
+        if err:
+            self.view.show_error(tr("Chan"), err)
+            return False
         try:
             addr = self.vm.chan_create(passphrase)
         except CommandError as exc:
@@ -228,6 +232,10 @@ class GUIController:
         return self.refresh()
 
     def chan_join(self, passphrase: str, address: str) -> bool:
+        err = self.vm.validate_chan(passphrase, address)
+        if err:
+            self.view.show_error(tr("Chan"), err)
+            return False
         try:
             self.vm.chan_join(passphrase, address)
         except CommandError as exc:

@@ -528,8 +528,12 @@ def solve(initial_hash: bytes, target: int, *,
     mask64 = (1 << 64) - 1
 
     def launch(base_int: int):
-        base = jnp.array([(base_int >> 32) & 0xFFFFFFFF,
-                          base_int & 0xFFFFFFFF], dtype=jnp.uint32)
+        import numpy as np
+
+        # numpy arg: the transfer rides the jit call itself instead of
+        # a separate explicit device-put round trip through the relay
+        base = np.array([(base_int >> 32) & 0xFFFFFFFF,
+                         base_int & 0xFFFFFFFF], dtype=np.uint32)
         return pallas_search(ih_words, base, target_arr, rows=rows,
                              chunks=chunks_per_call, unroll=unroll,
                              interpret=interpret)

@@ -408,6 +408,47 @@ class ViewModel:
             self.rpc.call("deleteSubscription",
                           self.subscriptions[index]["address"])
 
+    def validate_chan(self, passphrase: str,
+                      address: str = "") -> str | None:
+        """Pre-submit chan dialog validation (the reference's
+        AddressPassPhraseValidator, bitmessageqt/addressvalidator.py):
+        returns an error message, or None when the inputs look good.
+        The passphrase→address derivation runs locally (pure crypto,
+        no registration), so a mismatch is caught before anything
+        touches the daemon's keystore."""
+        if not passphrase:
+            return tr("Chan name/passphrase needed. You didn't enter a"
+                      " chan name.")
+        if not address:
+            return None
+        # live query, not the cached pane rows — the dialog may be
+        # validating right after a create/leave the cache hasn't seen
+        current = json.loads(self.rpc.call("listAddresses"))["addresses"]
+        if any(a["address"] == address for a in current):
+            return tr("Address already present as one of your"
+                      " identities.")
+        from .utils.addresses import decode_address
+        try:
+            a = decode_address(address)
+        except Exception as exc:
+            if getattr(exc, "status", "") == "versiontoohigh":
+                return tr("Address too new. Although that Bitmessage"
+                          " address might be valid, its version number"
+                          " is too new for us to handle.")
+            return tr("The Bitmessage address is not valid.")
+        if a.version not in (2, 3, 4):
+            return tr("The Bitmessage address is not valid.")
+        from .crypto.keys import grind_deterministic_keys
+        _, _, ripe, _ = grind_deterministic_keys(
+            passphrase.encode("utf-8"))
+        # compare RIPE bytes, not re-encoded strings: decode tolerates
+        # a missing BM- prefix and non-canonical encodings, and
+        # re-encoding can refuse versions decode accepts
+        if a.ripe != ripe:
+            return tr("Although the Bitmessage address you entered was"
+                      " valid, it doesn't match the chan name.")
+        return None
+
     def chan_create(self, passphrase: str) -> str:
         """Create a chan; its address derives from the passphrase."""
         return self.rpc.call("createChan", _b64(passphrase))

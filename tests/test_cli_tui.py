@@ -213,6 +213,55 @@ def _b64dec(s):
     return base64.b64decode(s).decode("utf-8", "replace")
 
 
+@pytest.mark.asyncio
+async def test_validate_chan_and_join_mismatch_leaves_no_identity():
+  """The chan validator (reference bitmessageqt/addressvalidator.py)
+  and the joinChan derive-before-register fix."""
+  async with live_api() as (node, rpc):
+    vm = ViewModel(rpc)
+    assert "chan name" in vm.validate_chan("")
+
+    chan_addr = await asyncio.to_thread(vm.chan_create, "vc phrase")
+    await asyncio.to_thread(vm.refresh)
+    # validate_chan makes RPC calls (live duplicate check) — it must
+    # run off the event loop like every other client call here
+    assert (await asyncio.to_thread(
+        vm.validate_chan, "vc phrase", chan_addr)).startswith(
+        "Address already present")
+    assert await asyncio.to_thread(
+        vm.validate_chan, "x", "BM-notanaddress") == \
+        "The Bitmessage address is not valid."
+
+    from pybitmessage_tpu.crypto.keys import grind_deterministic_keys
+    from pybitmessage_tpu.utils.addresses import encode_address
+    _, _, ripe, _ = await asyncio.to_thread(
+        grind_deterministic_keys, b"other phrase")
+    other = encode_address(4, 1, ripe)
+    # hand-craft a version-5 address (encode_address refuses to make
+    # one) to hit the validator's too-new branch
+    from pybitmessage_tpu.utils.base58 import b58encode
+    from pybitmessage_tpu.utils.hashes import double_sha512
+    from pybitmessage_tpu.utils.varint import encode_varint
+    v5_data = encode_varint(5) + encode_varint(1) + ripe.lstrip(b"\x00")
+    v5_addr = "BM-" + b58encode(v5_data + double_sha512(v5_data)[:4])
+    assert "Address too new" in await asyncio.to_thread(
+        vm.validate_chan, "other phrase", v5_addr)
+    assert "doesn't match the chan name" in \
+        await asyncio.to_thread(vm.validate_chan, "vc phrase", other)
+    assert await asyncio.to_thread(
+        vm.validate_chan, "other phrase", other) is None
+
+    # server side: a join with the wrong passphrase errors AND leaves
+    # no stray derived identity in the keystore
+    before = set(node.keystore.identities)
+    with pytest.raises(CommandError):
+        await asyncio.to_thread(vm.chan_join, "wrong phrase", other)
+    assert set(node.keystore.identities) == before
+    # the right passphrase joins cleanly
+    await asyncio.to_thread(vm.chan_join, "other phrase", other)
+    assert node.keystore.owns(other)
+
+
 def test_attachment_markup_roundtrip(tmp_path):
     """encode_attachment emits the reference's inline markup and
     extract_attachments recovers the exact bytes (bitmessagecli.py

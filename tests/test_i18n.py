@@ -85,8 +85,12 @@ def test_catalogs_cover_the_full_tr_surface():
     pkg = Path(i18n.__file__).resolve().parent.parent
     surface = set()
     for py in pkg.rglob("*.py"):
-        surface.update(re.findall(r'\btr\(\s*"((?:[^"\\]|\\.)+)"',
-                                  py.read_text()))
+        # adjacent "..." "..." fragments are one implicitly-concatenated
+        # literal — tr() receives the JOINED string at runtime
+        for m in re.finditer(r'\btr\(\s*((?:"(?:[^"\\]|\\.)+"\s*)+)',
+                             py.read_text()):
+            parts = re.findall(r'"((?:[^"\\]|\\.)+)"', m.group(1))
+            surface.add("".join(parts))
     assert len(surface) >= 40, "tr() surface scan looks broken"
     # the registry's screen titles reach tr() as variables
     # (Screen.label) — they are part of the surface too
