@@ -191,13 +191,17 @@ def _measure_mfu(initial_hash: bytes) -> dict:
         np.asarray(found)
     launch(0)                                      # already-warm no-op
     tmp = tempfile.mkdtemp(prefix="bm_mfu_trace_")
-    with jax.profiler.trace(tmp):
-        for i in range(3):
-            launch((i + 7) * trials)
-    latest = max(glob.glob(tmp + "/plugins/profile/*"))
-    (trace_file,) = glob.glob(latest + "/*.trace.json.gz")
-    with gzip.open(trace_file) as f:
-        trace = json.load(f)
+    try:
+        with jax.profiler.trace(tmp):
+            for i in range(3):
+                launch((i + 7) * trials)
+        latest = max(glob.glob(tmp + "/plugins/profile/*"))
+        (trace_file,) = glob.glob(latest + "/*.trace.json.gz")
+        with gzip.open(trace_file) as f:
+            trace = json.load(f)
+    finally:
+        import shutil
+        shutil.rmtree(tmp, ignore_errors=True)
     events = trace["traceEvents"]
     dev_pids = {e["pid"] for e in events
                 if e.get("ph") == "M" and e.get("name") == "process_name"

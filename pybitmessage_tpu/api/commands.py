@@ -279,7 +279,11 @@ class CommandHandler:
         a = decode_address(address)
         if a.version not in (2, 3, 4):
             raise APIError(2)
-        if self.node.keystore.owns(address):
+        # ownership check on the canonical form — decode tolerates a
+        # missing BM- prefix but the keystore stores canonical strings
+        from ..utils.addresses import encode_address
+        if self.node.keystore.owns(
+                encode_address(a.version, a.stream, a.ripe)):
             raise APIError(24)
         # derive FIRST, register only on a match — a mismatch must not
         # leave a stray derived identity in the keystore (the reference

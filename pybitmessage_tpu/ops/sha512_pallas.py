@@ -300,7 +300,10 @@ BATCH_CHUNKS = 64
 #: the batch grid keeps the unroll-4 configuration (64 objects x 64
 #: chunks x 4 streams compiled + solve-verified on-chip r4); the storm
 #: is launch-overhead-bound, not VPU-bound, so the single kernel's
-#: unroll-5 knee doesn't transfer
+#: unroll-5 knee doesn't transfer.  r5 measured the u5 batch grid
+#: anyway: storm 541 vs 531 obj/s (noise) and ~+5% on the
+#: real-difficulty batch, for +70 s Mosaic compile (142 -> 213 s) —
+#: below the knee, not worth the driver-bench wall time
 BATCH_UNROLL = 4
 
 

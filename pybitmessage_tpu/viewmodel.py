@@ -421,13 +421,7 @@ class ViewModel:
                       " chan name.")
         if not address:
             return None
-        # live query, not the cached pane rows — the dialog may be
-        # validating right after a create/leave the cache hasn't seen
-        current = json.loads(self.rpc.call("listAddresses"))["addresses"]
-        if any(a["address"] == address for a in current):
-            return tr("Address already present as one of your"
-                      " identities.")
-        from .utils.addresses import decode_address
+        from .utils.addresses import decode_address, encode_address
         try:
             a = decode_address(address)
         except Exception as exc:
@@ -438,6 +432,15 @@ class ViewModel:
             return tr("The Bitmessage address is not valid.")
         if a.version not in (2, 3, 4):
             return tr("The Bitmessage address is not valid.")
+        # duplicate check against the CANONICAL form (decode tolerates
+        # a missing BM- prefix; stored addresses are canonical), via a
+        # live query — the dialog may be validating right after a
+        # create/leave the cached pane rows haven't seen
+        canonical = encode_address(a.version, a.stream, a.ripe)
+        current = json.loads(self.rpc.call("listAddresses"))["addresses"]
+        if any(row["address"] == canonical for row in current):
+            return tr("Address already present as one of your"
+                      " identities.")
         from .crypto.keys import grind_deterministic_keys
         _, _, ripe, _ = grind_deterministic_keys(
             passphrase.encode("utf-8"))

@@ -228,6 +228,13 @@ async def test_validate_chan_and_join_mismatch_leaves_no_identity():
     assert (await asyncio.to_thread(
         vm.validate_chan, "vc phrase", chan_addr)).startswith(
         "Address already present")
+    # the duplicate check canonicalizes: a pasted address without the
+    # BM- prefix still counts as already-yours
+    assert (await asyncio.to_thread(
+        vm.validate_chan, "vc phrase", chan_addr[3:])).startswith(
+        "Address already present")
+    with pytest.raises(CommandError):   # server-side too (error 24)
+        await asyncio.to_thread(vm.chan_join, "vc phrase", chan_addr[3:])
     assert await asyncio.to_thread(
         vm.validate_chan, "x", "BM-notanaddress") == \
         "The Bitmessage address is not valid."

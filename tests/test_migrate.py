@@ -1,0 +1,166 @@
+"""Reference-data importer (migrate.py — the migration-wizard role):
+fixtures are built in the REFERENCE's own on-disk formats
+(class_sqlThread.py:49-84 schema, network/knownnodes.py:52-78 JSON,
+class_addressGenerator.py keys.dat sections) and imported into fresh
+framework stores."""
+
+import configparser
+import json
+import sqlite3
+
+from pybitmessage_tpu.crypto.keys import (
+    grind_deterministic_keys, wif_encode,
+)
+from pybitmessage_tpu.migrate import migrate
+from pybitmessage_tpu.storage.db import Database
+from pybitmessage_tpu.storage.knownnodes import KnownNodes, Peer
+from pybitmessage_tpu.storage.messages import MessageStore
+from pybitmessage_tpu.utils.addresses import encode_address
+from pybitmessage_tpu.workers.keystore import KeyStore
+
+
+def _make_ref_dir(tmp_path):
+    ref = tmp_path / "PyBitmessage"
+    ref.mkdir()
+
+    # keys.dat with one healthy identity, one chan, one corrupt section
+    sk, ek, ripe, _ = grind_deterministic_keys(b"migrate me")
+    addr = encode_address(4, 1, ripe)
+    csk, cek, cripe, _ = grind_deterministic_keys(b"migrate chan")
+    chan_addr = encode_address(4, 1, cripe)
+    cfg = configparser.ConfigParser(interpolation=None)
+    cfg.optionxform = str
+    cfg["bitmessagesettings"] = {"port": "8444"}
+    cfg[addr] = {
+        "label": "old main id", "enabled": "true",
+        "privsigningkey": wif_encode(sk),
+        "privencryptionkey": wif_encode(ek),
+        "noncetrialsperbyte": "2000", "payloadlengthextrabytes": "3000",
+        "gateway": "mailchuck",
+    }
+    cfg[chan_addr] = {
+        "label": "[chan] migrate chan", "chan": "true",
+        "privsigningkey": wif_encode(csk),
+        "privencryptionkey": wif_encode(cek),
+    }
+    # keys that do NOT match the section address must be rejected
+    cfg["BM-2cWzSnwjJ7yRP3nLEWUV5LisTZyREWSzUK"] = {
+        "label": "corrupt", "privsigningkey": wif_encode(sk),
+        "privencryptionkey": wif_encode(ek),
+    }
+    with open(ref / "keys.dat", "w") as f:
+        cfg.write(f)
+
+    # messages.dat in the reference's v11 shape
+    con = sqlite3.connect(ref / "messages.dat")
+    con.executescript("""
+        CREATE TABLE inbox (msgid blob, toaddress text, fromaddress text,
+          subject text, received text, message text, folder text,
+          encodingtype int, read bool, sighash blob,
+          UNIQUE(msgid) ON CONFLICT REPLACE);
+        CREATE TABLE sent (msgid blob, toaddress text, toripe blob,
+          fromaddress text, subject text, message text, ackdata blob,
+          senttime integer, lastactiontime integer, sleeptill integer,
+          status text, retrynumber integer, folder text,
+          encodingtype int, ttl int);
+        CREATE TABLE subscriptions (label text, address text, enabled bool);
+        CREATE TABLE addressbook (label text, address text,
+          UNIQUE(address) ON CONFLICT IGNORE);
+        CREATE TABLE blacklist (label text, address text, enabled bool);
+        CREATE TABLE whitelist (label text, address text, enabled bool);
+    """)
+    con.execute("INSERT INTO inbox VALUES (?,?,?,?,?,?,?,?,?,?)",
+                (b"refmsg1", addr, "BM-sender", "old subject", "1700000000",
+                 "old body", "inbox", 2, 1, b"H" * 32))
+    con.execute("INSERT INTO sent VALUES (?,?,?,?,?,?,?,?,?,?,?,?,?,?,?)",
+                (b"refsent1", "BM-dest", b"r" * 20, addr, "sent subj",
+                 "sent body", b"A" * 32, 1700000000, 1700000000, 0,
+                 "ackreceived", 0, "sent", 2, 3600))
+    con.execute("INSERT INTO sent VALUES (?,?,?,?,?,?,?,?,?,?,?,?,?,?,?)",
+                (b"refsent2", "BM-dest2", b"r" * 20, addr, "pending subj",
+                 "pending body", b"B" * 32, 1700000000, 1700000000, 0,
+                 "doingmsgpow", 0, "sent", 2, 3600))
+    con.execute("INSERT INTO addressbook VALUES (?,?)",
+                ("old pal", "BM-pal"))
+    con.execute("INSERT INTO subscriptions VALUES (?,?,?)",
+                ("old feed", "BM-feed", 1))
+    con.execute("INSERT INTO blacklist VALUES (?,?,?)",
+                ("old foe", "BM-foe", 1))
+    con.commit()
+    con.close()
+
+    # knownnodes.dat JSON
+    with open(ref / "knownnodes.dat", "w") as f:
+        json.dump([
+            {"stream": 1, "peer": {"host": "198.51.100.7", "port": 8444},
+             "info": {"lastseen": 1700000000, "rating": 0.4,
+                      "self": False}},
+            {"stream": 1, "peer": {"host": "203.0.113.9"},
+             "info": {"lastseen": 1700000001, "rating": -0.1}},
+            {"stream": 2, "peer": {"host": "192.0.2.3", "port": 8555},
+             "info": {"lastseen": 1700000002}},
+            {"bogus": True},
+        ], f)
+    return ref, addr, chan_addr
+
+
+def test_full_migration_and_idempotency(tmp_path):
+    ref, addr, chan_addr = _make_ref_dir(tmp_path)
+    home = tmp_path / "bmhome"
+
+    summary = migrate(ref, home)
+    assert summary["identities"] == 2          # corrupt section skipped
+    assert summary["inbox"] == 1
+    assert summary["sent"] == 2
+    assert summary["addressbook"] == 1
+    assert summary["subscriptions"] == 1
+    assert summary["blacklist"] == 1
+    assert summary["whitelist"] == 0
+    assert summary["knownnodes"] == 3          # bogus entry skipped
+
+    # identities carried keys, flags and per-address PoW demands
+    ks = KeyStore(home / "keys.dat")
+    ident = ks.get(addr)
+    assert ident.label == "old main id"
+    assert ident.nonce_trials_per_byte == 2000
+    assert ident.extra_bytes == 3000
+    assert ident.gateway == "mailchuck"
+    assert ks.get(chan_addr).chan
+
+    db = Database(home / "messages.dat")
+    try:
+        store = MessageStore(db)
+        inbox = store.inbox()
+        assert len(inbox) == 1 and inbox[0].subject == "old subject"
+        sent = {m.ackdata: m for m in store.all_sent()}
+        assert sent[b"A" * 32].status == "ackreceived"
+        # mid-flight reference statuses requeue under OUR state machine
+        assert sent[b"B" * 32].status == "msgqueued"
+        assert store.addressbook() == [("old pal", "BM-pal")]
+        assert store.listing("blacklist") == [("old foe", "BM-foe", True)]
+    finally:
+        db.close()
+
+    kn = KnownNodes(home / "knownnodes.dat")
+    assert kn.get(Peer("198.51.100.7", 8444))["rating"] == 0.4
+    assert kn.get(Peer("203.0.113.9", 8444)) is not None   # default port
+    assert kn.get(Peer("192.0.2.3", 8555), stream=2) is not None
+
+    # a locally-updated peer must survive a re-import: fresher rating
+    # and lastseen never get clobbered by the file's stale ones
+    rec = kn.get(Peer("198.51.100.7", 8444))
+    rec["rating"] = 0.9
+    rec["lastseen"] = 1800000000
+    kn.save()
+
+    # second run imports nothing new anywhere
+    again = migrate(ref, home)
+    assert all(v == 0 for v in again.values()), again
+    kn2 = KnownNodes(home / "knownnodes.dat")
+    assert kn2.count(1) == kn.count(1)
+    assert kn2.get(Peer("198.51.100.7", 8444))["rating"] == 0.9
+    assert kn2.get(Peer("198.51.100.7", 8444))["lastseen"] == 1800000000
+
+
+def test_migrate_empty_dir(tmp_path):
+    assert migrate(tmp_path, tmp_path / "out") == {}
