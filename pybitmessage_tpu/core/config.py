@@ -70,6 +70,9 @@ DEFAULTS: dict[str, str] = {
     "namecoinrpcuser": "",
     "namecoinrpcpassword": "",
     "inventorystorage": "sqlite",    # sqlite | filesystem
+    "userlocale": "system",          # UI language persisted for all
+                                     # attached frontends (reference:
+                                     # languagebox.py userlocale)
     "smtpdusername": "",
     "smtpdpassword": "",
     "powlanes": "131072",            # TPU search lanes per chunk

@@ -94,20 +94,50 @@ def available_languages() -> list[str]:
     return sorted(langs)
 
 
+#: locale tags that resolve to a differently-named catalog
+#: (Norwegian Bokmål/Nynorsk systems report nb_NO / nn_NO)
+_ALIASES = {"nb": "no", "nn": "no"}
+
+#: native display names for the language selector (reference:
+#: languagebox.py languageName + QLocale.nativeLanguageName)
+LANGUAGE_NAMES = {
+    "system": "System Settings",
+    "ar": "العربية", "cs": "Čeština", "da": "Dansk", "de": "Deutsch",
+    "en": "English", "en_pirate": "Pirate English", "eo": "Esperanto",
+    "es": "Español", "fr": "Français", "it": "Italiano", "ja": "日本語",
+    "nl": "Nederlands", "no": "Norsk", "pl": "Polski",
+    "pt": "Português", "ru": "Русский", "sk": "Slovenčina",
+    "sv": "Svenska", "zh_cn": "简体中文",
+}
+
+
+def native_name(lang: str) -> str:
+    """Display name of a catalog in its own language."""
+    return LANGUAGE_NAMES.get(lang, lang)
+
+
 def install(lang: str | None = None) -> str:
     """Load the catalog for ``lang`` (default: $LANGUAGE/$LANG, like
-    gettext).  Returns the language actually installed."""
+    gettext).  Returns the language actually installed.
+
+    Accepts any locale spelling — ``zh_CN.UTF-8``, ``zh_CN``,
+    ``zh_cn``, ``nb_NO`` — preferring a region-qualified catalog, then
+    the bare language, then aliases, then English."""
     global _catalog, _language
     if lang is None:
-        env = os.environ.get("LANGUAGE") or os.environ.get("LANG") or "en"
-        lang = env.split(":")[0].split(".")[0].split("_")[0]
-    path = LOCALE_DIR / (lang + ".po")
-    if lang != "en" and path.is_file():
-        _catalog = parse_po(path.read_text(encoding="utf-8"))
-        _language = lang
-    else:
-        _catalog = {}
-        _language = "en"
+        lang = (os.environ.get("LANGUAGE") or os.environ.get("LANG")
+                or "en").split(":")[0]
+    tag = lang.split(".")[0].strip().lower()
+    candidates = [tag, tag.split("_")[0]]
+    candidates += [_ALIASES[c] for c in list(candidates) if c in _ALIASES]
+    for cand in candidates:
+        path = LOCALE_DIR / (cand + ".po")
+        if cand != "en" and path.is_file():
+            _catalog = parse_po(path.read_text(encoding="utf-8"))
+            _language = cand
+            return _language
+    _catalog = {}
+    _language = "en"
     return _language
 
 

@@ -25,9 +25,11 @@ import argparse
 import sys
 
 from .cli import CommandError, RPCClient
-from .core.i18n import install as i18n_install, tr
+from .core.i18n import tr
 from .utils.identicon import derive
-from .viewmodel import EventPump, SEARCH_PANES, ViewModel, _unb64
+from .viewmodel import (
+    EventPump, SEARCH_PANES, ViewModel, _unb64, install_locale,
+)
 
 #: UI tick — only checks the event pump's flag (no RPC); a real
 #: refresh happens when the long-poll delivered events, giving
@@ -43,6 +45,7 @@ SETTING_FIELDS = (
     "port", "maxoutboundconnections", "maxtotalconnections",
     "maxdownloadrate", "maxuploadrate", "dandelion", "ttl",
     "blackwhitelist", "udp", "upnp", "tls", "powlanes", "powchunks",
+    "userlocale",
 )
 
 
@@ -649,8 +652,17 @@ class BMApp:  # pragma: no cover - widget glue; logic is GUIController.
         for row, key in enumerate(values):
             self.ttk.Label(win, text=key).grid(row=row, column=0,
                                                sticky="e", padx=4)
-            e = self.ttk.Entry(win, width=30)
-            e.insert(0, values[key])
+            if key == "userlocale":
+                # the LanguageBox analog: a dropdown of shipped
+                # catalogs shown by their native names
+                from .core.i18n import available_languages
+                e = self.ttk.Combobox(
+                    win, width=28, state="readonly",
+                    values=["system"] + available_languages())
+                e.set(values[key] or "system")
+            else:
+                e = self.ttk.Entry(win, width=30)
+                e.insert(0, values[key])
             e.grid(row=row, column=1, padx=4, pady=1)
             entries[key] = e
         backends = ", ".join(self.ctl.vm.settings.get("powBackends", []))
@@ -698,9 +710,9 @@ def main(argv=None) -> int:  # pragma: no cover - needs a display
     p.add_argument("--lang", default=None,
                    help="UI language (e.g. 'de'); default from $LANG")
     args = p.parse_args(argv)
-    i18n_install(args.lang)
     rpc = RPCClient(args.api_host, args.api_port, args.api_user,
                     args.api_password)
+    install_locale(rpc, args.lang)
     return BMApp(rpc).run()
 
 

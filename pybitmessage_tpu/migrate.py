@@ -80,50 +80,85 @@ def import_identities(keys_dat: Path, keystore) -> int:
     return imported
 
 
+def _import_inbox_row(store, row) -> bool:
+    if store.inbox_by_id(bytes(row[0] or b"")) is not None:
+        return False
+    store._db.execute(
+        "INSERT INTO inbox VALUES (?,?,?,?,?,?,?,?,?,?)",
+        (bytes(row[0] or b""), str(row[1] or ""), str(row[2] or ""),
+         str(row[3] or ""), str(row[4] or ""), str(row[5] or ""),
+         row[6] or "inbox", int(row[7] or 2), bool(row[8]),
+         bytes(row[9] or b"")))
+    return True
+
+
+def _import_sent_row(store, row) -> bool:
+    mid, ack = bytes(row[0] or b""), bytes(row[6] or b"")
+    toaddr, fromaddr = str(row[1] or ""), str(row[3] or "")
+    # dedup by msgid first (always present once sent), then
+    # ackdata, then the row's natural identity — so re-running
+    # never duplicates rows whose ids were still empty; the
+    # natural-identity values are coalesced exactly like the
+    # insert below so NULL columns still match on a re-run
+    if mid:
+        dup = store.sent_by_id(mid) is not None
+    elif ack:
+        dup = store.sent_by_ackdata(ack) is not None
+    else:
+        dup = store._db.query(
+            "SELECT COUNT(*) FROM sent WHERE toaddress=? AND"
+            " fromaddress=? AND senttime=? AND subject=?",
+            (toaddr, fromaddr, int(row[7] or 0), str(row[4] or "")))[0][0]
+    if dup:
+        return False
+    # terminal statuses import as-is; anything mid-flight
+    # becomes msgqueued so OUR send state machine owns it
+    status = row[10] if row[10] in (
+        "msgsent", "msgsentnoackexpected", "ackreceived",
+        "broadcastsent") else "msgqueued"
+    store._db.execute(
+        "INSERT INTO sent VALUES (?,?,?,?,?,?,?,?,?,?,?,?,?,?,?)",
+        (mid, toaddr, bytes(row[2] or b""),
+         fromaddr, str(row[4] or ""), str(row[5] or ""), ack,
+         int(row[7] or 0), int(row[8] or 0), int(row[9] or 0),
+         status, int(row[11] or 0), row[12] or "sent",
+         int(row[13] or 2), int(row[14] or 0)))
+    return True
+
+
 def import_messages(messages_dat: Path, store) -> dict:
     """Copy inbox/sent history and the four contact tables from the
-    reference messages.dat (schema v11 — column-compatible with ours)."""
+    reference messages.dat (schema v11 — column-compatible with ours).
+
+    SQLite columns are dynamically typed and v11 declares no type
+    constraints, so a malformed row (wrong type, missing field) is
+    skipped and counted rather than aborting the migration mid-way —
+    the same per-record tolerance as the keys.dat/knownnodes importers.
+    """
     src = sqlite3.connect(f"file:{messages_dat}?mode=ro", uri=True)
     counts = dict.fromkeys(
         ("inbox", "sent", "addressbook", "subscriptions", "blacklist",
-         "whitelist"), 0)
+         "whitelist", "skipped"), 0)
     try:
         for row in src.execute(
                 "SELECT msgid, toaddress, fromaddress, subject, received,"
                 " message, folder, encodingtype, read, sighash FROM inbox"):
-            if store.inbox_by_id(bytes(row[0] or b"")) is not None:
-                continue
-            store._db.execute(
-                "INSERT INTO inbox VALUES (?,?,?,?,?,?,?,?,?,?)",
-                (bytes(row[0] or b""), row[1], row[2], str(row[3]),
-                 str(row[4]), str(row[5]), row[6] or "inbox",
-                 int(row[7] or 2), bool(row[8]),
-                 bytes(row[9] or b"")))
-            counts["inbox"] += 1
+            try:
+                counts["inbox"] += _import_inbox_row(store, row)
+            except (TypeError, ValueError):
+                counts["skipped"] += 1
         for row in src.execute(
                 "SELECT msgid, toaddress, toripe, fromaddress, subject,"
                 " message, ackdata, senttime, lastactiontime, sleeptill,"
                 " status, retrynumber, folder, encodingtype, ttl"
                 " FROM sent"):
-            ack = bytes(row[6] or b"")
-            if ack and store.sent_by_ackdata(ack) is not None:
-                continue
-            # terminal statuses import as-is; anything mid-flight
-            # becomes msgqueued so OUR send state machine owns it
-            status = row[10] if row[10] in (
-                "msgsent", "msgsentnoackexpected", "ackreceived",
-                "broadcastsent") else "msgqueued"
-            store._db.execute(
-                "INSERT INTO sent VALUES (?,?,?,?,?,?,?,?,?,?,?,?,?,?,?)",
-                (bytes(row[0] or b""), row[1], bytes(row[2] or b""),
-                 row[3], str(row[4]), str(row[5]), ack,
-                 int(row[7] or 0), int(row[8] or 0), int(row[9] or 0),
-                 status, int(row[11] or 0), row[12] or "sent",
-                 int(row[13] or 2), int(row[14] or 0)))
-            counts["sent"] += 1
+            try:
+                counts["sent"] += _import_sent_row(store, row)
+            except (TypeError, ValueError):
+                counts["skipped"] += 1
         for label, address in src.execute(
                 "SELECT label, address FROM addressbook"):
-            if store.addressbook_add(address, str(label)):
+            if store.addressbook_add(str(address), str(label)):
                 counts["addressbook"] += 1
         for label, address, enabled in src.execute(
                 "SELECT label, address, enabled FROM subscriptions"):
@@ -136,9 +171,10 @@ def import_messages(messages_dat: Path, store) -> dict:
                     (str(label), address, bool(enabled)))
                 counts["subscriptions"] += 1
         for table in ("blacklist", "whitelist"):
-            for label, address, _enabled in src.execute(
+            for label, address, enabled in src.execute(
                     f"SELECT label, address, enabled FROM {table}"):
-                if store.listing_add(table, address, str(label)):
+                if store.listing_add(table, str(address), str(label),
+                                     enabled=bool(enabled)):
                     counts[table] += 1
     finally:
         src.close()
@@ -167,8 +203,14 @@ def import_knownnodes(knownnodes_dat: Path, kn) -> int:
                       lastseen=int(info.get("lastseen", 0)) or None,
                       is_self=bool(info.get("self"))):
                 rec = kn.get(peer, stream)
-                if rec is not None and "rating" in info:
-                    rec["rating"] = float(info["rating"])
+                if rec is not None:
+                    if "rating" in info:
+                        rec["rating"] = float(info["rating"])
+                    # carry the true lastseen through — kn.add stamps
+                    # "now" for falsy values, which would make a
+                    # never-seen peer (lastseen=0) look freshly seen
+                    if "lastseen" in info:
+                        rec["lastseen"] = int(info["lastseen"])
                 imported += 1
         except (KeyError, TypeError, ValueError):
             continue
@@ -219,7 +261,8 @@ def main(argv=None) -> int:
         print("nothing to import (no reference data files found)")
         return 1
     for key, count in summary.items():
-        print(f"{key}: {count} imported")
+        print(f"{key}: {count}" if key == "skipped"
+              else f"{key}: {count} imported")
     return 0
 
 

@@ -31,9 +31,9 @@ import inspect
 import sys
 
 from .cli import CommandError, RPCClient
-from .core.i18n import install as i18n_install, tr
+from .core.i18n import tr
 from .screens import Screen, bind, navigation
-from .viewmodel import EventPump, ViewModel, _clip
+from .viewmodel import EventPump, ViewModel, _clip, install_locale
 
 
 class MobileShell:
@@ -254,9 +254,10 @@ def main(argv=None) -> int:  # pragma: no cover - needs a tty
     p.add_argument("--lang", default=None,
                    help="UI language (e.g. 'de'); default from $LANG")
     args = p.parse_args(argv)
-    i18n_install(args.lang)
-    return run(RPCClient(args.api_host, args.api_port, args.api_user,
-                         args.api_password))
+    rpc = RPCClient(args.api_host, args.api_port, args.api_user,
+                    args.api_password)
+    install_locale(rpc, args.lang)
+    return run(rpc)
 
 
 if __name__ == "__main__":  # pragma: no cover

@@ -297,13 +297,14 @@ class MessageStore:
         return [(r[0], r[1], bool(r[2])) for r in self._db.query(
             "SELECT label, address, enabled FROM %s" % table)]
 
-    def listing_add(self, which: str, address: str, label: str) -> bool:
+    def listing_add(self, which: str, address: str, label: str,
+                    enabled: bool = True) -> bool:
         table = self._bw_table(which)
         if self._db.query("SELECT COUNT(*) FROM %s WHERE address=?" % table,
                           (address,))[0][0]:
             return False
-        self._db.execute("INSERT INTO %s VALUES (?,?,1)" % table,
-                         (label, address))
+        self._db.execute("INSERT INTO %s VALUES (?,?,?)" % table,
+                         (label, address, bool(enabled)))
         return True
 
     def listing_delete(self, which: str, address: str) -> None:

@@ -23,9 +23,9 @@ import argparse
 import sys
 
 from .cli import RPCClient, CommandError
-from .core.i18n import install as i18n_install
+from .core.i18n import tr
 from .viewmodel import (  # noqa: F401
-    EventPump, PANES, ViewModel, _b64, _clip, _unb64,
+    EventPump, PANES, ViewModel, _b64, _clip, _unb64, install_locale,
 )
 
 
@@ -35,7 +35,8 @@ def render_frame(vm: ViewModel, pane: str, selected: int, width: int,
     """Whole-screen render (header + body) as plain lines — the
     testable composition the curses shell paints.  ``overlay`` (e.g. a
     QR code) replaces the pane body until dismissed."""
-    tabs = "  ".join(("[%s]" % p) if p == pane else p for p in PANES)
+    tabs = "  ".join(("[%s]" % tr(p)) if p == pane else tr(p)
+                     for p in PANES)
     if vm.filter_text:
         tabs += "   /" + vm.filter_text
     out = [_clip(tabs, width), "-" * max(width - 1, 1)]
@@ -269,9 +270,10 @@ def main(argv=None) -> int:  # pragma: no cover - needs a tty
     p.add_argument("--lang", default=None,
                    help="UI language (e.g. 'de'); default from $LANG")
     args = p.parse_args(argv)
-    i18n_install(args.lang)
-    return run(RPCClient(args.api_host, args.api_port, args.api_user,
-                         args.api_password))
+    rpc = RPCClient(args.api_host, args.api_port, args.api_user,
+                    args.api_password)
+    install_locale(rpc, args.lang)
+    return run(rpc)
 
 
 if __name__ == "__main__":  # pragma: no cover

@@ -21,6 +21,25 @@ from .utils.safetext import extract_links, sanitize, sanitize_line
 PANES = ("Inbox", "Sent", "Identities", "Subscriptions", "Addressbook",
          "Blacklist", "Settings", "Network")
 
+def install_locale(rpc: RPCClient, explicit: str | None = None) -> str:
+    """Install the UI language with the reference's precedence
+    (languagebox.py persists ``bitmessagesettings.userlocale``):
+    ``--lang`` flag > the daemon's ``userlocale`` setting > $LANG.
+    An unreachable daemon falls back to the environment so frontends
+    still start (they reconnect later)."""
+    from .core.i18n import install
+    if explicit:
+        return install(explicit)
+    try:
+        configured = json.loads(
+            rpc.call("getSettings")).get("userlocale", "system")
+    except Exception:
+        configured = "system"
+    if configured and configured != "system":
+        return install(configured)
+    return install()
+
+
 #: widget/screen key -> searchable pane name (shared by the GUI bar,
 #: the mobile shell, and the screens registry)
 SEARCH_PANES = {

@@ -224,6 +224,47 @@ async def test_settings_dialog_roundtrip():
 
 
 @pytest.mark.asyncio
+async def test_userlocale_language_box_roundtrip(monkeypatch):
+    """The LanguageBox analog: userlocale persists through the settings
+    dialog and every frontend's install_locale honors it on startup
+    (reference: languagebox.py + bitmessagesettings.userlocale)."""
+    from pybitmessage_tpu.core import i18n
+    from pybitmessage_tpu.viewmodel import install_locale
+    async with live_controller() as (node, ctl, view):
+        try:
+            values = await asyncio.to_thread(ctl.load_settings)
+            assert values["userlocale"] == "system"
+            values["userlocale"] = "pl"
+            assert await asyncio.to_thread(ctl.save_settings, values)
+            rpc = ctl.vm.rpc
+            # frontend startup picks up the daemon's persisted language
+            assert await asyncio.to_thread(install_locale, rpc) == "pl"
+            assert i18n.tr("Inbox") == "Odebrane"
+            # an explicit --lang always wins
+            assert await asyncio.to_thread(
+                install_locale, rpc, "de") == "de"
+            # "system" defers to the environment
+            values = await asyncio.to_thread(ctl.load_settings)
+            values["userlocale"] = "system"
+            assert await asyncio.to_thread(ctl.save_settings, values)
+            monkeypatch.setenv("LANGUAGE", "it")
+            assert await asyncio.to_thread(install_locale, rpc) == "it"
+        finally:
+            i18n.install("en")
+
+
+def test_install_locale_daemon_unreachable(monkeypatch):
+    """No daemon -> environment fallback, frontend still starts."""
+    from pybitmessage_tpu.core import i18n
+    from pybitmessage_tpu.viewmodel import install_locale
+    try:
+        monkeypatch.setenv("LANGUAGE", "fr")
+        assert install_locale(RPCClient(port=1)) == "fr"
+    finally:
+        i18n.install("en")
+
+
+@pytest.mark.asyncio
 async def test_identicon_helper_for_canvas():
   async with live_controller() as (node, ctl, view):
     grid, color = ctl.identicon("BM-someaddress")

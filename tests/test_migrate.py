@@ -72,6 +72,10 @@ def _make_ref_dir(tmp_path):
     con.execute("INSERT INTO inbox VALUES (?,?,?,?,?,?,?,?,?,?)",
                 (b"refmsg1", addr, "BM-sender", "old subject", "1700000000",
                  "old body", "inbox", 2, 1, b"H" * 32))
+    # the v11 schema declares no NOT NULL — NULL text must import as ""
+    con.execute("INSERT INTO inbox VALUES (?,?,?,?,?,?,?,?,?,?)",
+                (b"refmsg2", addr, "BM-sender", None, "1700000001",
+                 None, "inbox", 2, 0, b"I" * 32))
     con.execute("INSERT INTO sent VALUES (?,?,?,?,?,?,?,?,?,?,?,?,?,?,?)",
                 (b"refsent1", "BM-dest", b"r" * 20, addr, "sent subj",
                  "sent body", b"A" * 32, 1700000000, 1700000000, 0,
@@ -80,12 +84,22 @@ def _make_ref_dir(tmp_path):
                 (b"refsent2", "BM-dest2", b"r" * 20, addr, "pending subj",
                  "pending body", b"B" * 32, 1700000000, 1700000000, 0,
                  "doingmsgpow", 0, "sent", 2, 3600))
+    # a sent row whose ids were never assigned (reference inserts ''
+    # before the first send attempt) must still import idempotently —
+    # even with NULL address columns (v11 declares no NOT NULL)
+    con.execute("INSERT INTO sent VALUES (?,?,?,?,?,?,?,?,?,?,?,?,?,?,?)",
+                (b"", None, b"", addr, "no ids yet",
+                 "unsent body", b"", 1700000003, 1700000003, 0,
+                 "msgqueued", 0, "sent", 2, 3600))
     con.execute("INSERT INTO addressbook VALUES (?,?)",
                 ("old pal", "BM-pal"))
     con.execute("INSERT INTO subscriptions VALUES (?,?,?)",
                 ("old feed", "BM-feed", 1))
     con.execute("INSERT INTO blacklist VALUES (?,?,?)",
                 ("old foe", "BM-foe", 1))
+    # a foe the user explicitly un-blocked must stay disabled
+    con.execute("INSERT INTO blacklist VALUES (?,?,?)",
+                ("dead foe", "BM-foe2", 0))
     con.commit()
     con.close()
 
@@ -99,6 +113,9 @@ def _make_ref_dir(tmp_path):
              "info": {"lastseen": 1700000001, "rating": -0.1}},
             {"stream": 2, "peer": {"host": "192.0.2.3", "port": 8555},
              "info": {"lastseen": 1700000002}},
+            # never actually seen — must NOT import as freshly-seen
+            {"stream": 1, "peer": {"host": "192.0.2.77", "port": 8444},
+             "info": {"lastseen": 0, "rating": 0.0}},
             {"bogus": True},
         ], f)
     return ref, addr, chan_addr
@@ -110,13 +127,13 @@ def test_full_migration_and_idempotency(tmp_path):
 
     summary = migrate(ref, home)
     assert summary["identities"] == 2          # corrupt section skipped
-    assert summary["inbox"] == 1
-    assert summary["sent"] == 2
+    assert summary["inbox"] == 2
+    assert summary["sent"] == 3
     assert summary["addressbook"] == 1
     assert summary["subscriptions"] == 1
-    assert summary["blacklist"] == 1
+    assert summary["blacklist"] == 2
     assert summary["whitelist"] == 0
-    assert summary["knownnodes"] == 3          # bogus entry skipped
+    assert summary["knownnodes"] == 4          # bogus entry skipped
 
     # identities carried keys, flags and per-address PoW demands
     ks = KeyStore(home / "keys.dat")
@@ -130,14 +147,21 @@ def test_full_migration_and_idempotency(tmp_path):
     db = Database(home / "messages.dat")
     try:
         store = MessageStore(db)
-        inbox = store.inbox()
-        assert len(inbox) == 1 and inbox[0].subject == "old subject"
+        inbox = {m.msgid: m for m in store.inbox()}
+        assert inbox[b"refmsg1"].subject == "old subject"
+        # NULL text columns import as empty strings, not "None"
+        assert inbox[b"refmsg2"].subject == ""
+        assert inbox[b"refmsg2"].message == ""
         sent = {m.ackdata: m for m in store.all_sent()}
         assert sent[b"A" * 32].status == "ackreceived"
         # mid-flight reference statuses requeue under OUR state machine
         assert sent[b"B" * 32].status == "msgqueued"
+        assert sent[b""].subject == "no ids yet"
+        assert sent[b""].toaddress == ""       # NULL address coalesced
         assert store.addressbook() == [("old pal", "BM-pal")]
-        assert store.listing("blacklist") == [("old foe", "BM-foe", True)]
+        # the disabled entry stays disabled
+        assert sorted(store.listing("blacklist")) == [
+            ("dead foe", "BM-foe2", False), ("old foe", "BM-foe", True)]
     finally:
         db.close()
 
@@ -145,6 +169,9 @@ def test_full_migration_and_idempotency(tmp_path):
     assert kn.get(Peer("198.51.100.7", 8444))["rating"] == 0.4
     assert kn.get(Peer("203.0.113.9", 8444)) is not None   # default port
     assert kn.get(Peer("192.0.2.3", 8555), stream=2) is not None
+    # the true lastseen carries through, even the never-seen zero
+    assert kn.get(Peer("198.51.100.7", 8444))["lastseen"] == 1700000000
+    assert kn.get(Peer("192.0.2.77", 8444))["lastseen"] == 0
 
     # a locally-updated peer must survive a re-import: fresher rating
     # and lastseen never get clobbered by the file's stale ones
