@@ -32,9 +32,20 @@ import statistics
 import sys
 import time
 
+from pybitmessage_tpu.observability import (REGISTRY, enable_jax_annotations,
+                                            snapshot, trace)
+
 LANES = 1 << 19
 CHUNKS = 64
 REPS = 5
+
+#: device-side kernel time per production slab, fed from the profiler
+#: trace in _measure_mfu — the histogram form of the quantity MFU is
+#: derived from (ISSUE 1 satellite: no more ad-hoc locals)
+SLAB_DEVICE_SECONDS = REGISTRY.histogram(
+    "pow_slab_device_seconds",
+    "Device-side kernel duration of one production Pallas slab "
+    "(from the XLA profiler trace)")
 
 
 def _host_rate(initial_hash: bytes, trials: int = 20000) -> float:
@@ -191,18 +202,26 @@ def _measure_mfu(initial_hash: bytes) -> dict:
         np.asarray(found)
     launch(0)                                      # already-warm no-op
     tmp = tempfile.mkdtemp(prefix="bm_mfu_trace_")
+    # mirror spans into TraceAnnotations while the profiler runs so
+    # slab launches are named in the XLA trace
+    enable_jax_annotations(True)
     try:
         with jax.profiler.trace(tmp):
             for i in range(3):
-                launch((i + 7) * trials)
+                # the span mirrors into a TraceAnnotation (bridge
+                # enabled above) so the slab launch is named in the
+                # XLA trace
+                with trace("bench.slab", slab=i):
+                    launch((i + 7) * trials)
         latest = max(glob.glob(tmp + "/plugins/profile/*"))
         (trace_file,) = glob.glob(latest + "/*.trace.json.gz")
         with gzip.open(trace_file) as f:
-            trace = json.load(f)
+            trace_json = json.load(f)
     finally:
+        enable_jax_annotations(False)
         import shutil
         shutil.rmtree(tmp, ignore_errors=True)
-    events = trace["traceEvents"]
+    events = trace_json["traceEvents"]
     dev_pids = {e["pid"] for e in events
                 if e.get("ph") == "M" and e.get("name") == "process_name"
                 and "TPU" in (e["args"].get("name") or "")}
@@ -215,6 +234,10 @@ def _measure_mfu(initial_hash: bytes) -> dict:
     # the kernel dominates the trace by orders of magnitude
     _name, durs = max(groups.items(),
                       key=lambda kv: statistics.median(kv[1]))
+    # per-slab device timings flow through the shared histogram — the
+    # snapshot in the output JSON then carries percentile latencies
+    for d in durs:
+        SLAB_DEVICE_SECONDS.observe(d * 1e-6)
     device_s = statistics.median(durs) * 1e-6
     rate = trials / device_s
     return {
@@ -521,6 +544,10 @@ def main():
             "vs_cpp": round(device / native, 2) if native else None,
         },
         "configs": configs,
+        # full registry state at the end of the run: every solve/slab
+        # histogram with count/sum/p50/p90/p99 (ISSUE 1 satellite —
+        # BENCH_r*.json gains percentile latencies)
+        "metrics_snapshot": snapshot(),
     }))
 
 

@@ -724,6 +724,49 @@ class CommandHandler:
 
     # -- status / admin ------------------------------------------------------
 
+    def cmd_metrics(self):
+        """Prometheus text exposition of the process-wide registry —
+        the same bytes ``GET /metrics`` serves (docs/observability.md
+        catalogs every series)."""
+        from ..observability import render_prometheus
+        return render_prometheus()
+
+    def _pow_stats(self) -> dict:
+        """Per-tier PoW stats for clientStatus, read from the metrics
+        registry (solve counts + trials per backend, fallbacks, batch
+        behavior)."""
+        from ..observability import REGISTRY
+        per_backend = {}
+        solve = REGISTRY.get("pow_solve_seconds")
+        trials = REGISTRY.get("pow_trials_total")
+        if solve is not None:
+            for values, child in solve.children():
+                _, seconds_sum, count = child.snapshot()
+                per_backend[values[0]] = {
+                    "solves": count,
+                    "solveSecondsTotal": round(seconds_sum, 6),
+                }
+        if trials is not None:
+            for values, child in trials.children():
+                per_backend.setdefault(values[0], {})["trials"] = \
+                    int(child.value)
+        fallbacks = {}
+        fb = REGISTRY.get("pow_fallback_total")
+        if fb is not None:
+            for values, child in fb.children():
+                fallbacks["->".join(values)] = int(child.value)
+        batch = REGISTRY.get("pow_batch_size")
+        batch_stats = {}
+        if batch is not None and not batch.labelnames:
+            batch_stats = {
+                "batches": batch.count,
+                "meanSize": round(batch.sum / batch.count, 2)
+                if batch.count else 0.0,
+                "p90Size": round(batch.percentile(0.90), 1),
+            }
+        return {"perBackend": per_backend, "fallbacks": fallbacks,
+                "batch": batch_stats}
+
     def cmd_clientStatus(self):
         pool = self.node.pool
         established = len(pool.established())
@@ -769,8 +812,21 @@ class CommandHandler:
             "powBackend": getattr(self.node.solver, "last_backend", ""),
             "powRate": round(getattr(self.node.solver, "last_rate", 0.0),
                              1),
+            # solve-only rate (no host verify) — comparable to bench.py
+            "powSolveRate": round(
+                getattr(self.node.solver, "last_solve_rate", 0.0), 1),
             "powQueueDepth": (self.node.pow_service.queue.qsize()
                               if self.node.pow_service else 0),
+            # per-tier solve counts/latencies, fallback events, batch
+            # coalescing stats from the metrics registry (ISSUE 1)
+            "powStats": self._pow_stats(),
+            "powVerify": {
+                "host": getattr(self.node.pow_verifier, "host_checked", 0),
+                "device": getattr(self.node.pow_verifier,
+                                  "device_checked", 0),
+                "deviceBatches": getattr(self.node.pow_verifier,
+                                         "device_batches", 0),
+            },
         }, indent=4)
 
     def cmd_deleteAndVacuum(self):

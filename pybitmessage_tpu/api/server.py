@@ -6,7 +6,9 @@ reference's apivariants are served on the same port, auto-detected per
 request: a JSON body is JSON-RPC 2.0 (``{"method", "params", "id"}``),
 an XML body is XML-RPC — the protocol the reference's own
 ``bitmessagecli.py`` (xmlrpclib) speaks, so that client works against
-this daemon unchanged.  API errors surface as numbered
+this daemon unchanged.  ``GET /metrics`` (same basic auth) serves the
+Prometheus text exposition of the process-wide telemetry registry
+(docs/observability.md).  API errors surface as numbered
 ``APIError NN: message`` strings (JSON error object / XML-RPC Fault),
 matching the reference's error vocabulary (api.py:111-153).
 """
@@ -89,6 +91,22 @@ class APIServer:
                 return
             body = await reader.readexactly(length) if length else b""
 
+            if request_line.startswith(b"GET"):
+                path = request_line.split()[1].decode("latin-1", "replace") \
+                    if len(request_line.split()) > 1 else ""
+                if path.split("?")[0] == "/metrics":
+                    if not self._authorized(headers):
+                        await self._respond(
+                            writer, 401, {"error": "unauthorized"},
+                            extra="WWW-Authenticate: Basic\r\n")
+                        return
+                    from ..observability import render_prometheus
+                    await self._respond_raw(
+                        writer, 200, render_prometheus().encode("utf-8"),
+                        "text/plain; version=0.0.4; charset=utf-8")
+                    return
+                await self._respond(writer, 404, {"error": "not found"})
+                return
             if not request_line.startswith(b"POST"):
                 await self._respond(writer, 405,
                                     {"error": "POST JSON-RPC only"})
@@ -164,7 +182,8 @@ class APIServer:
     async def _respond_raw(writer, status: int, body: bytes,
                            content_type: str, extra: str = "") -> None:
         reason = {200: "OK", 400: "Bad Request", 401: "Unauthorized",
-                  405: "Method Not Allowed", 413: "Payload Too Large"}
+                  404: "Not Found", 405: "Method Not Allowed",
+                  413: "Payload Too Large"}
         head = (f"HTTP/1.1 {status} {reason.get(status, '')}\r\n"
                 f"Content-Type: {content_type}\r\n"
                 f"Content-Length: {len(body)}\r\n"

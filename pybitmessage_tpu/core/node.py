@@ -122,6 +122,7 @@ class Node:
             from ..network.udp import UDPDiscovery
             self.udp = UDPDiscovery(self.pool)
         self._pump_task: asyncio.Task | None = None
+        self._metrics_task: asyncio.Task | None = None
 
     def _solve(self, initial_hash, target, should_stop=None):
         return self.solver(initial_hash, target, should_stop=should_stop)
@@ -139,6 +140,10 @@ class Node:
         if self.udp is not None:
             await self.udp.start()
         self._pump_task = asyncio.create_task(self._pump_objects())
+        # periodic structured-log telemetry snapshot (ISSUE 1): one
+        # JSON line per minute covering only metrics that changed
+        from ..observability import log_snapshot_task
+        self._metrics_task = asyncio.create_task(log_snapshot_task(60.0))
         logger.info("node started (port %s)",
                     self.pool.listen_port if self.listen else "-")
 
@@ -153,6 +158,8 @@ class Node:
         self.shutdown.set()
         if self._pump_task:
             self._pump_task.cancel()
+        if self._metrics_task:
+            self._metrics_task.cancel()
         if self.udp is not None:
             await self.udp.stop()
         await self.pool.stop()

@@ -27,6 +27,7 @@ from ..models.packet import (
     HEADER_LEN, PacketError, pack_packet, unpack_header, verify_payload,
 )
 from ..models.pow_math import check_pow
+from ..observability import REGISTRY
 from ..utils.hashes import inventory_hash
 from ..utils.varint import VarintError
 from .messages import (
@@ -48,6 +49,17 @@ BIG_INV_CHUNK = 50000
 #: lets one peer's flood coalesce into device batches without letting
 #: it queue unbounded payloads
 VERIFY_WINDOW = 32
+
+PACKETS = REGISTRY.counter(
+    "network_packets_total", "Framed protocol packets by direction",
+    ("direction",))
+# children bound once — the per-packet path must not pay a family
+# lock + label lookup per frame
+PACKETS_RX = PACKETS.labels(direction="rx")
+PACKETS_TX = PACKETS.labels(direction="tx")
+PACKET_ERRORS = REGISTRY.counter(
+    "network_packet_errors_total",
+    "Frames dropped for bad checksum / oversize payload")
 
 
 class ConnectionClosed(Exception):
@@ -173,10 +185,13 @@ class BMConnection:
             header = header[nxt:] + await self._read_throttled(nxt)
         command, length, checksum = unpack_header(header)
         if length > MAX_MESSAGE_SIZE:
+            PACKET_ERRORS.inc()
             raise ConnectionClosed("oversize payload")
         payload = await self._read_throttled(length)
         if not verify_payload(payload, checksum):
+            PACKET_ERRORS.inc()
             raise ConnectionClosed("bad checksum")
+        PACKETS_RX.inc()
         self.last_activity = time.time()
         handler = getattr(self, "cmd_" + command, None)
         if handler is None:
@@ -187,6 +202,7 @@ class BMConnection:
     async def send_packet(self, command: str, payload: bytes = b"") -> None:
         frame = pack_packet(command, payload)
         await self.ctx.upload_bucket.consume(len(frame))
+        PACKETS_TX.inc()
         self.writer.write(frame)
         await self.writer.drain()
 

@@ -17,6 +17,7 @@ import time
 from collections import deque
 from typing import Callable, Optional
 
+from ..observability import REGISTRY
 from ..storage.knownnodes import Peer
 from .connection import BMConnection
 from .messages import AddrEntry, is_private_host, network_group
@@ -24,6 +25,16 @@ from .ratelimit import TokenBucket
 from .tracker import GlobalTracker
 
 logger = logging.getLogger("pybitmessage_tpu.network")
+
+CONNECTIONS = REGISTRY.gauge(
+    "network_connections", "Open connections by direction",
+    ("direction",))
+DIALS = REGISTRY.counter(
+    "network_dial_total", "Outbound dial attempts by outcome",
+    ("result",))
+OBJECTS_RECEIVED = REGISTRY.counter(
+    "network_objects_received_total",
+    "Valid objects accepted from the network")
 
 
 def _is_local_address(host: str) -> bool:
@@ -79,8 +90,8 @@ class NodeContext:
         self.announce_buckets = announce_buckets or ANNOUNCE_BUCKETS
         #: kB/s-style global throttles (0 = unlimited), reference
         #: maxdownloadrate/maxuploadrate semantics
-        self.download_bucket = TokenBucket(0)
-        self.upload_bucket = TokenBucket(0)
+        self.download_bucket = TokenBucket(0, direction="rx")
+        self.upload_bucket = TokenBucket(0, direction="tx")
         self.global_tracker = GlobalTracker()
         #: validated objects flow out here: (hash, header, payload)
         self.object_queue: asyncio.Queue = asyncio.Queue()
@@ -138,6 +149,8 @@ class ConnectionPool:
     # -- lifecycle -----------------------------------------------------------
 
     async def start(self, listen: bool = True) -> None:
+        CONNECTIONS.labels(direction="inbound").set(len(self.inbound))
+        CONNECTIONS.labels(direction="outbound").set(len(self.outbound))
         if listen:
             self._server = await asyncio.start_server(
                 self._accept, self.listen_host, self.ctx.port)
@@ -179,6 +192,7 @@ class ConnectionPool:
         conn = BMConnection(self, reader, writer, outbound=False,
                             host=peer[0], port=peer[1])
         self.inbound[conn] = None
+        CONNECTIONS.labels(direction="inbound").set(len(self.inbound))
         conn.start()
 
     async def connect_to(self, peer: Peer) -> BMConnection | None:
@@ -196,11 +210,14 @@ class ConnectionPool:
                     timeout=10)
         except (OSError, asyncio.TimeoutError) as exc:
             logger.debug("dial %s failed: %r", peer, exc)
+            DIALS.labels(result="failed").inc()
             self.ctx.knownnodes.decrease_rating(peer)
             return None
         conn = BMConnection(self, reader, writer, outbound=True,
                             host=peer.host, port=peer.port)
         self.outbound[conn] = None
+        DIALS.labels(result="connected").inc()
+        CONNECTIONS.labels(direction="outbound").set(len(self.outbound))
         conn.start()
         return conn
 
@@ -214,6 +231,8 @@ class ConnectionPool:
     def connection_closed(self, conn: BMConnection) -> None:
         self.inbound.pop(conn, None)
         self.outbound.pop(conn, None)
+        CONNECTIONS.labels(direction="inbound").set(len(self.inbound))
+        CONNECTIONS.labels(direction="outbound").set(len(self.outbound))
         if self.ctx.dandelion:
             self.ctx.dandelion.remove_connection(conn)
         if conn.outbound and not conn.fully_established:
@@ -246,6 +265,7 @@ class ConnectionPool:
     def object_received(self, h: bytes, header, payload: bytes,
                         source) -> None:
         """A new valid object arrived: queue for processing + relay."""
+        OBJECTS_RECEIVED.inc()
         for conn in self.established():
             if conn is not source:
                 conn.tracker.we_should_announce(h)

@@ -1,0 +1,19 @@
+"""Process-wide telemetry: metrics registry, span tracer, exporters.
+
+See docs/observability.md for the full catalog of exported metrics.
+"""
+
+from .export import log_snapshot_task, render_prometheus, snapshot
+from .metrics import (DEFAULT_LATENCY_BUCKETS, DEFAULT_SIZE_BUCKETS,
+                      REGISTRY, Counter, Gauge, Histogram, Registry)
+from .tracing import (TRACER, Span, Tracer, current_span,
+                      enable_jax_annotations, jax_annotations_enabled,
+                      trace)
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "Registry", "REGISTRY",
+    "DEFAULT_LATENCY_BUCKETS", "DEFAULT_SIZE_BUCKETS",
+    "Span", "Tracer", "TRACER", "trace", "current_span",
+    "enable_jax_annotations", "jax_annotations_enabled",
+    "render_prometheus", "snapshot", "log_snapshot_task",
+]

@@ -7,7 +7,8 @@ flag.  Here the accelerator tier is the JAX/Pallas TPU search and the
 native tier is a self-built C++ pthread solver.
 """
 
-from .dispatcher import PowDispatcher, python_solve  # noqa: F401
+from .dispatcher import (PowDispatcher, host_trial,  # noqa: F401
+                         python_solve)
 from .native import NativeSolver  # noqa: F401
 from .service import PowService  # noqa: F401
 from .verify_service import BatchVerifier  # noqa: F401

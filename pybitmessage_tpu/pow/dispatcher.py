@@ -13,10 +13,45 @@ import logging
 import time
 from typing import Callable
 
+from ..observability import REGISTRY, trace
 from ..ops.pow_search import PowInterrupted
 from .native import NativeSolver
 
 logger = logging.getLogger("pybitmessage_tpu.pow")
+
+SOLVE_SECONDS = REGISTRY.histogram(
+    "pow_solve_seconds",
+    "Solve-only latency of one PoW launch (single object or fused "
+    "batch), excluding the dispatcher's host verification",
+    ("backend",))
+HOST_VERIFY_SECONDS = REGISTRY.histogram(
+    "pow_host_verify_seconds",
+    "Host-side double-SHA512 re-check of a winning nonce")
+ATTEMPTS = REGISTRY.counter(
+    "pow_attempts_total", "Solve attempts entering each ladder tier",
+    ("backend",))
+FALLBACKS = REGISTRY.counter(
+    "pow_fallback_total",
+    "Ladder fallthrough events (a tier failed and a slower one took "
+    "over)", ("from", "to"))
+TRIALS = REGISTRY.counter(
+    "pow_trials_total", "Double-SHA512 trial hashes executed",
+    ("backend",))
+MESH_COMPILES = REGISTRY.counter(
+    "pow_mesh_compiles_total",
+    "Device mesh constructions, one per distinct (ndev, obj) shape — "
+    "a proxy for per-shape XLA compiles", ("shape",))
+
+
+def host_trial(nonce: int, initial_hash: bytes) -> int:
+    """One double-SHA512 trial value — THE PoW formula.
+
+    ``python_solve`` inlines the same computation for loop speed; keep
+    the two in lockstep."""
+    sha512 = hashlib.sha512
+    return int.from_bytes(sha512(sha512(
+        nonce.to_bytes(8, "big") + initial_hash).digest()
+    ).digest()[:8], "big")
 
 
 def python_solve(initial_hash: bytes, target: int, *,
@@ -45,6 +80,20 @@ class PowDispatcher:
     range-partitioned across the whole mesh (``sharded_solve``) and
     :meth:`solve_batch` maps a queue of pending objects onto a 2D
     (objects x nonce-range) mesh — the pod-wide production path.
+
+    Timing attributes (also exported through the metrics registry):
+
+    ``last_rate``
+        trials/sec over the WALL time of the last ``solve()`` /
+        ``solve_batch()`` call — solve plus the dispatcher's host
+        re-verification of the winning nonce.  This is the end-to-end
+        figure a caller experiences and what clientStatus reports.
+    ``last_solve_seconds`` / ``last_solve_rate``
+        solve-only time (device/native/python search, no host verify)
+        and the corresponding trials/sec — the number to compare
+        against bench.py kernel rates.
+    ``last_verify_seconds``
+        host double-SHA512 re-check time of the last winning nonce.
     """
 
     def __init__(self, *, use_tpu: bool = True, use_native: bool = True,
@@ -55,6 +104,9 @@ class PowDispatcher:
         self._native = NativeSolver(num_threads) if use_native else None
         self.last_backend = ""
         self.last_rate = 0.0
+        self.last_solve_seconds = 0.0
+        self.last_solve_rate = 0.0
+        self.last_verify_seconds = 0.0
         self._meshes: dict = {}
 
     # -- device topology -----------------------------------------------------
@@ -77,6 +129,7 @@ class PowDispatcher:
         key = (ndev, obj_size)
         if key not in self._meshes:
             from ..parallel import make_mesh
+            MESH_COMPILES.labels(shape="%dx%d" % key).inc()
             if obj_size == 1:
                 self._meshes[key] = make_mesh(ndev)
             else:
@@ -96,11 +149,30 @@ class PowDispatcher:
     def __call__(self, initial_hash: bytes, target: int, *,
                  start_nonce: int = 0,
                  should_stop: Callable[[], bool] | None = None):
-        t0 = time.monotonic()
-        nonce, trials = self._solve(
-            initial_hash, target, start_nonce, should_stop)
-        dt = max(time.monotonic() - t0, 1e-9)
-        self.last_rate = trials / dt
+        with trace("pow.solve") as span:
+            t0 = time.monotonic()
+            nonce, trials = self._solve(
+                initial_hash, target, start_nonce, should_stop)
+            solve_dt = max(time.monotonic() - t0, 1e-9)
+            # host re-check of the winning nonce (reference
+            # proofofwork semantics), timed apart from the search so
+            # last_solve_rate stays a pure solver figure
+            v0 = time.monotonic()
+            value = host_trial(nonce, initial_hash)
+            verify_dt = time.monotonic() - v0
+            if value > target:
+                logger.warning(
+                    "backend %s returned nonce failing host verification",
+                    self.last_backend)
+            span.attrs["backend"] = self.last_backend
+            span.attrs["trials"] = trials
+        self.last_solve_seconds = solve_dt
+        self.last_solve_rate = trials / solve_dt
+        self.last_verify_seconds = verify_dt
+        self.last_rate = trials / (solve_dt + verify_dt)
+        SOLVE_SECONDS.labels(backend=self.last_backend).observe(solve_dt)
+        HOST_VERIFY_SECONDS.observe(verify_dt)
+        TRIALS.labels(backend=self.last_backend).inc(trials)
         return nonce, trials
 
     # keep the explicit name too
@@ -119,59 +191,78 @@ class PowDispatcher:
             return []
         t0 = time.monotonic()
         results = None
-        if self._tpu_enabled and len(items) > 1:
-            ndev = self._device_count()
-            if ndev > 1:
-                if self._pallas_enabled and self._on_accelerator():
+        with trace("pow.solve_batch", objects=len(items)) as span:
+            if self._tpu_enabled and len(items) > 1:
+                ndev = self._device_count()
+                if ndev > 1:
+                    if self._pallas_enabled and self._on_accelerator():
+                        try:
+                            from ..parallel import pallas_sharded_solve_batch
+                            self.last_backend = "tpu-pallas-sharded-batch"
+                            ATTEMPTS.labels(backend=self.last_backend).inc()
+                            results = pallas_sharded_solve_batch(
+                                items, self._mesh(ndev, len(items)),
+                                should_stop=should_stop)
+                        except PowInterrupted:
+                            raise
+                        except Exception:
+                            logger.exception(
+                                "sharded batched Pallas PoW failed; using "
+                                "sharded XLA batch")
+                            self._pallas_enabled = False
+                            FALLBACKS.labels(
+                                **{"from": "tpu-pallas",
+                                   "to": "tpu-xla"}).inc()
+                    if results is None:
+                        try:
+                            from ..parallel import sharded_solve_batch
+                            self.last_backend = "tpu-batch"
+                            ATTEMPTS.labels(backend=self.last_backend).inc()
+                            results = sharded_solve_batch(
+                                items, self._mesh(ndev, len(items)),
+                                should_stop=should_stop,
+                                **self._xla_kwargs())
+                        except PowInterrupted:
+                            raise
+                        except Exception:
+                            logger.exception(
+                                "batched TPU PoW failed; falling back to "
+                                "per-object solves")
+                            FALLBACKS.labels(
+                                **{"from": "tpu-batch",
+                                   "to": "ladder"}).inc()
+                elif self._pallas_enabled and self._on_accelerator():
+                    # single chip: one Mosaic launch carries the whole
+                    # batch on a 2D (objects x chunks) grid with
+                    # per-object early exit
                     try:
-                        from ..parallel import pallas_sharded_solve_batch
-                        self.last_backend = "tpu-pallas-sharded-batch"
-                        results = pallas_sharded_solve_batch(
-                            items, self._mesh(ndev, len(items)),
-                            should_stop=should_stop)
+                        from ..ops.sha512_pallas import solve_batch
+                        self.last_backend = "tpu-pallas-batch"
+                        ATTEMPTS.labels(backend=self.last_backend).inc()
+                        results = solve_batch(items, should_stop=should_stop)
                     except PowInterrupted:
                         raise
                     except Exception:
+                        # latch off like the per-object ladder: a broken
+                        # Mosaic kernel must not re-pay a ~75 s failed
+                        # compile on every subsequent batch
                         logger.exception(
-                            "sharded batched Pallas PoW failed; using "
-                            "sharded XLA batch")
-                        self._pallas_enabled = False
-                if results is None:
-                    try:
-                        from ..parallel import sharded_solve_batch
-                        self.last_backend = "tpu-batch"
-                        results = sharded_solve_batch(
-                            items, self._mesh(ndev, len(items)),
-                            should_stop=should_stop, **self._xla_kwargs())
-                    except PowInterrupted:
-                        raise
-                    except Exception:
-                        logger.exception(
-                            "batched TPU PoW failed; falling back to "
+                            "batched Pallas PoW failed; falling back to "
                             "per-object solves")
-            elif self._pallas_enabled and self._on_accelerator():
-                # single chip: one Mosaic launch carries the whole
-                # batch on a 2D (objects x chunks) grid with
-                # per-object early exit
-                try:
-                    from ..ops.sha512_pallas import solve_batch
-                    self.last_backend = "tpu-pallas-batch"
-                    results = solve_batch(items, should_stop=should_stop)
-                except PowInterrupted:
-                    raise
-                except Exception:
-                    # latch off like the per-object ladder: a broken
-                    # Mosaic kernel must not re-pay a ~75 s failed
-                    # compile on every subsequent batch
-                    logger.exception(
-                        "batched Pallas PoW failed; falling back to "
-                        "per-object solves")
-                    self._pallas_enabled = False
-        if results is None:
-            results = [self._solve(ih, t, 0, should_stop)
-                       for ih, t in items]
+                        self._pallas_enabled = False
+                        FALLBACKS.labels(
+                            **{"from": "tpu-pallas", "to": "ladder"}).inc()
+            if results is None:
+                results = [self._solve(ih, t, 0, should_stop)
+                           for ih, t in items]
+            span.attrs["backend"] = self.last_backend
         dt = max(time.monotonic() - t0, 1e-9)
-        self.last_rate = sum(r[1] for r in results) / dt
+        trials = sum(r[1] for r in results)
+        self.last_solve_seconds = dt
+        self.last_solve_rate = trials / dt
+        self.last_rate = trials / dt
+        SOLVE_SECONDS.labels(backend=self.last_backend).observe(dt)
+        TRIALS.labels(backend=self.last_backend).inc(trials)
         return results
 
     def _on_accelerator(self) -> bool:
@@ -203,6 +294,7 @@ class PowDispatcher:
                         try:
                             from ..parallel import pallas_sharded_solve
                             self.last_backend = "tpu-pallas-sharded"
+                            ATTEMPTS.labels(backend=self.last_backend).inc()
                             return pallas_sharded_solve(
                                 initial_hash, target, self._mesh(ndev, 1),
                                 start_nonce=start_nonce,
@@ -214,8 +306,12 @@ class PowDispatcher:
                                 "sharded Pallas PoW failed; using "
                                 "sharded XLA search")
                             self._pallas_enabled = False
+                            FALLBACKS.labels(
+                                **{"from": "tpu-pallas",
+                                   "to": "tpu-xla"}).inc()
                     from ..parallel import sharded_solve
                     self.last_backend = "tpu-sharded"
+                    ATTEMPTS.labels(backend=self.last_backend).inc()
                     return sharded_solve(
                         initial_hash, target, self._mesh(ndev, 1),
                         start_nonce=start_nonce, should_stop=should_stop,
@@ -228,6 +324,7 @@ class PowDispatcher:
                     try:
                         from ..ops.sha512_pallas import solve as pl_solve
                         self.last_backend = "tpu-pallas"
+                        ATTEMPTS.labels(backend=self.last_backend).inc()
                         return pl_solve(initial_hash, target,
                                         start_nonce=start_nonce,
                                         should_stop=should_stop)
@@ -237,8 +334,11 @@ class PowDispatcher:
                         logger.exception(
                             "Pallas PoW failed; using XLA search")
                         self._pallas_enabled = False
+                        FALLBACKS.labels(
+                            **{"from": "tpu-pallas", "to": "tpu-xla"}).inc()
                 from ..ops.pow_search import solve as tpu_solve
                 self.last_backend = "tpu"
+                ATTEMPTS.labels(backend=self.last_backend).inc()
                 return tpu_solve(initial_hash, target,
                                  start_nonce=start_nonce,
                                  should_stop=should_stop,
@@ -250,9 +350,14 @@ class PowDispatcher:
                     "TPU PoW failed; falling through to C++ "
                     "(reference resetPoW semantics)")
                 self._tpu_enabled = False
+                next_tier = ("native"
+                             if self._native is not None
+                             and self._native.available else "python")
+                FALLBACKS.labels(**{"from": "tpu", "to": next_tier}).inc()
         if self._native is not None and self._native.available:
             try:
                 self.last_backend = "cpp"
+                ATTEMPTS.labels(backend=self.last_backend).inc()
                 return self._native.solve(initial_hash, target,
                                           start_nonce=start_nonce,
                                           should_stop=should_stop)
@@ -260,6 +365,8 @@ class PowDispatcher:
                 raise
             except Exception:
                 logger.exception("C++ PoW failed; falling through to python")
+                FALLBACKS.labels(**{"from": "native", "to": "python"}).inc()
         self.last_backend = "python"
+        ATTEMPTS.labels(backend=self.last_backend).inc()
         return python_solve(initial_hash, target, start_nonce=start_nonce,
                             should_stop=should_stop)

@@ -18,8 +18,26 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import time
+
+from ..observability import DEFAULT_SIZE_BUCKETS, REGISTRY
 
 logger = logging.getLogger("pybitmessage_tpu.pow")
+
+BATCH_SIZE = REGISTRY.histogram(
+    "pow_batch_size",
+    "Objects coalesced into one solve_batch launch (window occupancy)",
+    buckets=DEFAULT_SIZE_BUCKETS)
+QUEUE_WAIT = REGISTRY.histogram(
+    "pow_queue_wait_seconds",
+    "Time a solve request waited in the coalescing queue before its "
+    "batch launched")
+QUEUE_DEPTH = REGISTRY.gauge(
+    "pow_queue_depth", "Solve requests currently queued or coalescing")
+BATCHES = REGISTRY.counter(
+    "pow_batches_total", "Coalesced solve_batch launches")
+SOLVED = REGISTRY.counter(
+    "pow_solved_total", "Solve requests completed through the service")
 
 
 class PowService:
@@ -51,7 +69,8 @@ class PowService:
     async def solve(self, initial_hash: bytes, target: int):
         """Queue one solve; returns (nonce, trials) when its batch lands."""
         fut = asyncio.get_running_loop().create_future()
-        await self.queue.put((initial_hash, target, fut))
+        await self.queue.put((initial_hash, target, fut, time.monotonic()))
+        QUEUE_DEPTH.set(self.queue.qsize())
         return await fut
 
     async def _run(self) -> None:
@@ -62,27 +81,34 @@ class PowService:
             batch = [first]
             while not self.queue.empty():
                 batch.append(self.queue.get_nowait())
-            items = [(ih, t) for ih, t, _ in batch]
+            now = time.monotonic()
+            for *_, enqueued in batch:
+                QUEUE_WAIT.observe(now - enqueued)
+            BATCH_SIZE.observe(len(batch))
+            QUEUE_DEPTH.set(self.queue.qsize())
+            items = [(ih, t) for ih, t, _, _ in batch]
             loop = asyncio.get_running_loop()
             try:
                 results = await loop.run_in_executor(
                     None, lambda: self.dispatcher.solve_batch(
                         items, should_stop=self.shutdown.is_set))
             except asyncio.CancelledError:
-                for *_, fut in batch:
+                for _, _, fut, _ in batch:
                     if not fut.done():
                         fut.cancel()
                 raise
             except Exception as exc:
-                for *_, fut in batch:
+                for _, _, fut, _ in batch:
                     if not fut.done():
                         fut.set_exception(exc)
                 continue
             self.batches += 1
             self.solved += len(batch)
+            BATCHES.inc()
+            SOLVED.inc(len(batch))
             if len(batch) > 1:
                 logger.info("batched PoW: %d objects in one launch (%s)",
                             len(batch), self.dispatcher.last_backend)
-            for (_, _, fut), res in zip(batch, results):
+            for (_, _, fut, _), res in zip(batch, results):
                 if not fut.done():
                     fut.set_result(res)

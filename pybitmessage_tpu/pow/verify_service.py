@@ -21,8 +21,22 @@ import time
 from ..models.constants import (DEFAULT_EXTRA_BYTES,
                                 DEFAULT_NONCE_TRIALS_PER_BYTE)
 from ..models.pow_math import check_pow, pow_target
+from ..observability import DEFAULT_SIZE_BUCKETS, REGISTRY
 
 logger = logging.getLogger("pybitmessage_tpu.pow")
+
+VERIFIED = REGISTRY.counter(
+    "pow_verify_total",
+    "Incoming-object PoW checks by execution path", ("path",))
+VERIFY_BATCHES = REGISTRY.counter(
+    "pow_verify_batches_total", "Device verification batches launched")
+VERIFY_BATCH_SIZE = REGISTRY.histogram(
+    "pow_verify_batch_size",
+    "Objects per coalesced verification drain (host or device)",
+    buckets=DEFAULT_SIZE_BUCKETS)
+VERIFY_REJECTED = REGISTRY.counter(
+    "pow_verify_rejected_total",
+    "Incoming objects whose embedded PoW failed the target")
 
 
 class BatchVerifier:
@@ -98,18 +112,23 @@ class BatchVerifier:
             while not self.queue.empty():
                 batch.append(self.queue.get_nowait())
             results = None
+            VERIFY_BATCH_SIZE.observe(len(batch))
             if self.use_device and len(batch) >= self.min_device_batch:
                 try:
                     results = await self._device_verify(
                         [ob for ob, _ in batch])
                     self.device_checked += len(batch)
                     self.device_batches += 1
+                    VERIFIED.labels(path="device").inc(len(batch))
+                    VERIFY_BATCHES.inc()
                 except Exception:
                     logger.exception(
                         "device PoW verification failed; host fallback")
             if results is None:
                 results = [self._host_check(ob) for ob, _ in batch]
                 self.host_checked += len(batch)
+                VERIFIED.labels(path="host").inc(len(batch))
+            VERIFY_REJECTED.inc(sum(1 for ok in results if not ok))
             for (_, fut), ok in zip(batch, results):
                 if not fut.done():
                     fut.set_result(bool(ok))

@@ -31,6 +31,7 @@ from ..models.payloads import (
     msg_signed_data, parse_pubkey_inner,
 )
 from ..models.pow_math import pow_target, pow_value
+from ..observability import REGISTRY, trace
 from ..storage.messages import ACKRECEIVED, MessageStore
 from ..utils.addresses import encode_address
 from ..utils.hashes import address_ripe, inventory_hash, sha512
@@ -42,6 +43,13 @@ logger = logging.getLogger("pybitmessage_tpu.processor")
 
 #: don't resend our pubkey more often than this (objectProcessor.py:176-268)
 PUBKEY_RESEND_INTERVAL = 28 * 24 * 3600
+
+OBJECTS_PROCESSED = REGISTRY.counter(
+    "worker_objects_processed_total",
+    "Objects through the processor pipeline by type", ("type",))
+PROCESS_SECONDS = REGISTRY.histogram(
+    "worker_process_seconds",
+    "Per-object processing latency (decrypt, verify, store)")
 
 
 class ObjectProcessor:
@@ -127,17 +135,32 @@ class ObjectProcessor:
         try:
             header = ObjectHeader.parse(payload)
         except Exception:
+            OBJECTS_PROCESSED.labels(type="unparseable").inc()
             return
-        if header.object_type == OBJECT_GETPUBKEY:
-            await self._process_getpubkey(header, payload)
-        elif header.object_type == OBJECT_PUBKEY:
-            self._process_pubkey(header, payload)
-        elif header.object_type == OBJECT_MSG:
-            await self._process_msg(header, payload)
-        elif header.object_type == OBJECT_BROADCAST:
-            self._process_broadcast(header, payload)
-        elif header.object_type == OBJECT_ONIONPEER:
-            self._process_onionpeer(header, payload)
+        kind = "other"
+        try:
+            with trace("processor.object",
+                       histogram=PROCESS_SECONDS) as span:
+                if header.object_type == OBJECT_GETPUBKEY:
+                    kind = "getpubkey"
+                    await self._process_getpubkey(header, payload)
+                elif header.object_type == OBJECT_PUBKEY:
+                    kind = "pubkey"
+                    self._process_pubkey(header, payload)
+                elif header.object_type == OBJECT_MSG:
+                    kind = "msg"
+                    await self._process_msg(header, payload)
+                elif header.object_type == OBJECT_BROADCAST:
+                    kind = "broadcast"
+                    self._process_broadcast(header, payload)
+                elif header.object_type == OBJECT_ONIONPEER:
+                    kind = "onionpeer"
+                    self._process_onionpeer(header, payload)
+                span.attrs["type"] = kind
+        finally:
+            # count failed objects too — a raising handler must not
+            # leave worker_process_seconds ahead of the counter
+            OBJECTS_PROCESSED.labels(type=kind).inc()
 
     # -- onionpeer -----------------------------------------------------------
 

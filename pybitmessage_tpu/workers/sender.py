@@ -23,8 +23,9 @@ from ..crypto import decrypt, encrypt, sign, verify
 from ..crypto.ecies import DecryptionError
 from ..models import msgcoding
 from ..models.constants import (
-    DEFAULT_EXTRA_BYTES, DEFAULT_NONCE_TRIALS_PER_BYTE, OBJECT_MSG,
-    OBJECT_ONIONPEER, OBJECT_PUBKEY, RIDICULOUS_DIFFICULTY,
+    DEFAULT_EXTRA_BYTES, DEFAULT_NONCE_TRIALS_PER_BYTE, OBJECT_BROADCAST,
+    OBJECT_GETPUBKEY, OBJECT_MSG, OBJECT_ONIONPEER, OBJECT_PUBKEY,
+    RIDICULOUS_DIFFICULTY,
 )
 from ..models.payloads import (
     MsgPlaintext, BroadcastPlaintext, PayloadError, PubkeyData,
@@ -33,6 +34,7 @@ from ..models.payloads import (
     bitfield_does_ack, object_shell, parse_pubkey_inner,
 )
 from ..models.pow_math import pow_target
+from ..observability import REGISTRY, trace
 from ..storage.messages import (
     ACKRECEIVED, AWAITINGPUBKEY, BROADCASTSENT, DOINGMSGPOW,
     DOINGPUBKEYPOW, MSGQUEUED, MSGSENT, MSGSENTNOACKEXPECTED, MessageStore,
@@ -46,6 +48,18 @@ logger = logging.getLogger("pybitmessage_tpu.worker")
 
 #: re-request a pubkey after this long (class_singleWorker.py getpubkey)
 GETPUBKEY_RETRY = 2.5 * 24 * 3600
+
+POW_WAIT_SECONDS = REGISTRY.histogram(
+    "worker_pow_wait_seconds",
+    "End-to-end PoW wait in the send pipeline: coalescing queue + "
+    "solve + host verify")
+OBJECTS_PUBLISHED = REGISTRY.counter(
+    "worker_objects_published_total",
+    "Locally generated objects entered into the inventory",
+    ("type",))
+_TYPE_NAMES = {OBJECT_GETPUBKEY: "getpubkey", OBJECT_MSG: "msg",
+               OBJECT_PUBKEY: "pubkey", OBJECT_BROADCAST: "broadcast",
+               OBJECT_ONIONPEER: "onionpeer"}
 
 
 def _jitter_ttl(ttl: int) -> int:
@@ -170,13 +184,16 @@ class SendWorker:
                             clamp=False)
         initial = sha512(payload_sans_nonce)
         t0 = time.monotonic()
-        if self.pow_service is not None:
-            nonce, trials = await self.pow_service.solve(initial, target)
-        else:
-            loop = asyncio.get_running_loop()
-            nonce, trials = await loop.run_in_executor(
-                None, lambda: self.solver(initial, target,
-                                          should_stop=self.shutdown.is_set))
+        with trace("worker.pow", bytes=len(payload_sans_nonce) + 8,
+                   histogram=POW_WAIT_SECONDS):
+            if self.pow_service is not None:
+                nonce, trials = await self.pow_service.solve(initial, target)
+            else:
+                loop = asyncio.get_running_loop()
+                nonce, trials = await loop.run_in_executor(
+                    None,
+                    lambda: self.solver(initial, target,
+                                        should_stop=self.shutdown.is_set))
         dt = max(time.monotonic() - t0, 1e-9)
         logger.info("PoW done: %d trials in %.2fs (%.0f H/s)",
                     trials, dt, trials / dt)
@@ -186,6 +203,8 @@ class SendWorker:
                  tag: bytes = b"") -> bytes:
         h = inventory_hash(payload)
         expires = int.from_bytes(payload[8:16], "big")
+        OBJECTS_PUBLISHED.labels(
+            type=_TYPE_NAMES.get(object_type, str(object_type))).inc()
         self.inventory.add(h, object_type, stream, payload, expires, tag)
         if self.pool is not None:
             self.pool.announce_object(h, stream, local=True)
@@ -379,7 +398,7 @@ class SendWorker:
         # (class_singleWorker.py:874-895 doingpubkeypow stage)
         self.store.update_sent_status(ackdata, DOINGPUBKEYPOW)
         payload = await self._do_pow(payload, ttl)
-        self._publish(payload, 0, to.stream)
+        self._publish(payload, OBJECT_GETPUBKEY, to.stream)
         self.store.update_sent_status(
             ackdata, AWAITINGPUBKEY,
             sleeptill=int(time.time() + GETPUBKEY_RETRY))
@@ -514,7 +533,7 @@ class SendWorker:
         from ..crypto import priv_to_pub
         payload = shell + encrypt(plain.encode(), priv_to_pub(key))
         payload = await self._do_pow(payload, ttl)
-        h = self._publish(payload, 3, sender.stream, tag)
+        h = self._publish(payload, OBJECT_BROADCAST, sender.stream, tag)
         self.store.update_sent_status(m.ackdata, BROADCASTSENT)
         logger.info("broadcast sent, hash %s", h.hex())
 

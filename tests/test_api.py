@@ -315,4 +315,34 @@ def test_client_status(api_env):
         # the real default is the PowDispatcher ladder
         assert st["powBackends"] in (["custom"],) or \
             "tpu" in st["powBackends"]
+        # telemetry enrichment (ISSUE 1): per-tier stats, fallbacks,
+        # batch coalescing, and verifier path split are always present
+        assert set(st["powStats"]) == {"perBackend", "fallbacks",
+                                       "batch"}
+        assert isinstance(st["powStats"]["perBackend"], dict)
+        assert set(st["powVerify"]) == {"host", "device",
+                                        "deviceBatches"}
+        assert "powSolveRate" in st
+    run_api_test(api_env, body)
+
+
+def test_client_status_reflects_pow_tier_stats(api_env):
+    """A solve through the dispatcher ladder must surface in
+    clientStatus powStats.perBackend (ISSUE 1 satellite)."""
+    import hashlib
+
+    from pybitmessage_tpu.pow import PowDispatcher
+
+    async def body(client, node):
+        node.solver = PowDispatcher(use_tpu=False)
+        loop = asyncio.get_event_loop()
+        await loop.run_in_executor(
+            None, lambda: node.solver(
+                hashlib.sha512(b"status solve").digest(), 2 ** 59))
+        _, resp = await client.call("clientStatus")
+        st = json.loads(resp["result"])
+        tier = st["powStats"]["perBackend"][st["powBackend"]]
+        assert tier["solves"] >= 1
+        assert tier["trials"] >= 1
+        assert st["powSolveRate"] > 0
     run_api_test(api_env, body)

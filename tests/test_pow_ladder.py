@@ -72,3 +72,42 @@ def test_dispatcher_tpu_tier():
     # on the 8-virtual-device test mesh the pod-sharded path dispatches
     assert d.last_backend == "tpu-sharded"
     assert _host_trial(nonce, IH) <= EASY
+
+
+def test_forced_tpu_failure_increments_fallback_counter(monkeypatch):
+    """ISSUE 1 satellite: a dead TPU tier must show up as
+    pow_fallback_total{from="tpu",to="native"} and land on cpp."""
+    from pybitmessage_tpu import ops
+    from pybitmessage_tpu.observability import REGISTRY
+
+    d = PowDispatcher(use_tpu=True)
+    monkeypatch.setattr(d, "_device_count", lambda: 1)
+    monkeypatch.setattr(d, "_on_accelerator", lambda: False)
+
+    def boom(*args, **kwargs):
+        raise RuntimeError("forced TPU failure")
+
+    monkeypatch.setattr(ops.pow_search, "solve", boom)
+    labels = {"from": "tpu", "to": "native"}
+    before = REGISTRY.sample("pow_fallback_total", labels)
+    solves_before = REGISTRY.sample("pow_solve_seconds",
+                                    {"backend": "cpp"})
+    nonce, _ = d(IH, EASY)
+    assert d.last_backend == "cpp"
+    assert _host_trial(nonce, IH) <= EASY
+    assert REGISTRY.sample("pow_fallback_total", labels) == before + 1
+    # the rescued solve is attributed to the tier that finished it
+    assert REGISTRY.sample("pow_solve_seconds",
+                           {"backend": "cpp"}) == solves_before + 1
+    # latched off: the dead tier must not be retried
+    assert "tpu" not in d.backends()
+
+
+def test_solve_only_timing_recorded_separately():
+    """ISSUE 1 satellite: last_rate stays the wall figure (solve +
+    host verify) while last_solve_rate excludes the verify."""
+    d = PowDispatcher(use_tpu=False)
+    d(IH, EASY)
+    assert d.last_solve_seconds > 0
+    assert d.last_verify_seconds >= 0
+    assert d.last_solve_rate >= d.last_rate > 0
