@@ -2,7 +2,7 @@
 # build + tox targets).  The C++ solver is also auto-built at runtime by
 # pybitmessage_tpu/pow/native.py when missing or stale.
 
-.PHONY: all native test bench clean
+.PHONY: all native test bench bench-smoke clean
 
 all: native
 
@@ -14,6 +14,11 @@ test: native
 
 bench: native
 	python bench.py
+
+# tiny CPU-only pipeline bench for CI: reduced slabs, reference
+# test-mode difficulty, XLA impl (see docs/pow_pipeline.md)
+bench-smoke:
+	JAX_PLATFORMS=cpu python bench.py --smoke
 
 clean:
 	$(MAKE) -C native/pow clean

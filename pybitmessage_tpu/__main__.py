@@ -105,7 +105,8 @@ async def run(args) -> int:
                 dandelion_enabled=settings.getint("dandelion") > 0,
                 tls_enabled=settings.getbool("tls"),
                 udp_enabled=settings.getbool("udp") and not args.no_listen,
-                inventory_backend=settings.get("inventorystorage"))
+                inventory_backend=settings.get("inventorystorage"),
+                pow_window=settings.getfloat("powbatchwindow"))
     node.settings = settings
     node.dandelion.stem_probability = settings.getint("dandelion")
     node.processor.list_mode = settings.get("blackwhitelist")

@@ -756,16 +756,23 @@ class CommandHandler:
             for values, child in fb.children():
                 fallbacks["->".join(values)] = int(child.value)
         batch = REGISTRY.get("pow_batch_size")
-        batch_stats = {}
+        # single source of truth: the registry counters (the service's
+        # own attributes are views over these)
+        batch_stats = {
+            "batches": int(REGISTRY.sample("pow_batches_total")),
+            "solved": int(REGISTRY.sample("pow_solved_total")),
+        }
         if batch is not None and not batch.labelnames:
-            batch_stats = {
-                "batches": batch.count,
+            batch_stats.update({
                 "meanSize": round(batch.sum / batch.count, 2)
                 if batch.count else 0.0,
                 "p90Size": round(batch.percentile(0.90), 1),
-            }
+            })
+        svc = getattr(self.node, "pow_service", None)
+        batch_stats["window"] = svc.window if svc is not None else None
+        from ..pow.pipeline import pipeline_snapshot
         return {"perBackend": per_backend, "fallbacks": fallbacks,
-                "batch": batch_stats}
+                "batch": batch_stats, "pipeline": pipeline_snapshot()}
 
     def cmd_clientStatus(self):
         pool = self.node.pool

@@ -77,6 +77,8 @@ DEFAULTS: dict[str, str] = {
     "smtpdpassword": "",
     "powlanes": "131072",            # TPU search lanes per chunk
     "powchunks": "32",               # chunks per jitted call
+    "powbatchwindow": "0.05",        # PoW coalescing window, seconds
+                                     # (0 = launch immediately)
     "blackwhitelist": "black",       # inbound sender policy
     # ceilings on recipient-demanded PoW; 0 = unlimited (reference
     # helper_startup sanity cap: ridiculousDifficulty x network default)
@@ -103,6 +105,15 @@ def _validate_bool(value: str) -> bool:
     return value.lower() in ("true", "false", "0", "1", "yes", "no")
 
 
+def _validate_float_range(lo: float, hi: float) -> Callable[[str], bool]:
+    def check(value: str) -> bool:
+        try:
+            return lo <= float(value) <= hi
+        except ValueError:
+            return False
+    return check
+
+
 #: per-option validators (reference validate_<section>_<option>,
 #: bmconfigparser.py:142-158 — notably maxoutbound <= 8)
 VALIDATORS: dict[str, Callable[[str], bool]] = {
@@ -118,6 +129,7 @@ VALIDATORS: dict[str, Callable[[str], bool]] = {
     "ttl": _validate_int_range(300, 28 * 24 * 3600),
     "powlanes": _validate_int_range(128, 1 << 24),
     "powchunks": _validate_int_range(1, 4096),
+    "powbatchwindow": _validate_float_range(0.0, 10.0),
     "apienabled": _validate_bool,
     "notifysound": _validate_bool,
     "smtpdenabled": _validate_bool,

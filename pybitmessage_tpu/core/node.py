@@ -40,7 +40,8 @@ class Node:
                  allow_private_peers: bool = False,
                  stream: int = 1, test_mode: bool = False,
                  tls_enabled: bool = True, udp_enabled: bool = False,
-                 inventory_backend: str = "sqlite"):
+                 inventory_backend: str = "sqlite",
+                 pow_window: float | None = None):
         self.data_dir = Path(data_dir) if data_dir else None
         if self.data_dir:
             self.data_dir.mkdir(parents=True, exist_ok=True)
@@ -95,7 +96,8 @@ class Node:
         if hasattr(self.solver, "solve_batch"):
             from ..pow.service import PowService
             self.pow_service = PowService(self.solver,
-                                          shutdown=self.shutdown)
+                                          shutdown=self.shutdown,
+                                          window=pow_window)
 
         from .uisignal import UISignaler
         self.ui = UISignaler()

@@ -45,7 +45,7 @@ SETTING_FIELDS = (
     "port", "maxoutboundconnections", "maxtotalconnections",
     "maxdownloadrate", "maxuploadrate", "dandelion", "ttl",
     "blackwhitelist", "udp", "upnp", "tls", "powlanes", "powchunks",
-    "userlocale",
+    "powbatchwindow", "userlocale",
 )
 
 

@@ -49,23 +49,31 @@ class PowInterrupted(Exception):
 
 def _run_host_driver(search_once, initial_hash: bytes, target: int, *,
                      start_nonce: int, trials_per_call_step: int,
-                     should_stop: Callable[[], bool] | None):
+                     should_stop: Callable[[], bool] | None,
+                     on_slab: Callable[[float], None] | None = None):
     """Shared host loop over a jitted search slab.
 
     ``search_once(b_hi, b_lo) -> (found, n_hi, n_lo, chunks)``;
     ``trials_per_call_step`` = trials represented by one chunk across
-    all participating devices.  Re-verifies the winning nonce with
-    hashlib before returning, guarding against accelerator miscompute
-    (the reference re-checks OpenCL results, proofofwork.py:302-313).
+    all participating devices.  ``on_slab`` (if given) receives each
+    slab's measured wall seconds — the autotuner's latency feedback.
+    Re-verifies the winning nonce with hashlib before returning,
+    guarding against accelerator miscompute (the reference re-checks
+    OpenCL results, proofofwork.py:302-313).
     """
+    import time as _time
+
     base = start_nonce
     trials = 0
     while True:
         if should_stop is not None and should_stop():
             raise PowInterrupted("PoW interrupted by shutdown")
         b_hi, b_lo = u64_from_int(base)
+        t0 = _time.monotonic()
         found, n_hi, n_lo, chunks = search_once(b_hi, b_lo)
-        chunks = int(chunks)
+        chunks = int(chunks)          # host pull — forces completion
+        if on_slab is not None:
+            on_slab(_time.monotonic() - t0)
         trials += chunks * trials_per_call_step
         if bool(found):
             nonce = u64_to_int(n_hi, n_lo)
@@ -124,24 +132,43 @@ def solve(initial_hash: bytes, target: int, *,
           lanes: int = DEFAULT_LANES,
           chunks_per_call: int = DEFAULT_CHUNKS_PER_CALL,
           variant: str = DEFAULT_VARIANT,
-          should_stop: Callable[[], bool] | None = None):
+          should_stop: Callable[[], bool] | None = None,
+          tuner=None, tuner_kind: str = "xla"):
     """Find a nonce whose trial value is <= target.
 
     Host driver over :func:`pow_search_jit`; between jitted slabs the
     optional ``should_stop`` callback is polled (shutdown semantics of
-    reference proofofwork.py:104-191).  Returns (nonce, trials_done) or
-    raises :class:`PowInterrupted` when interrupted.
+    reference proofofwork.py:104-191).  ``tuner`` (a
+    ``pow.pipeline.SlabAutotuner``-shaped object) replaces the
+    hardcoded chunk constant with a measured-latency-derived slab
+    size; the winning nonce is slab-shape invariant (consecutive
+    ranges — regression-tested), so autotuning never changes results.
+    Returns (nonce, trials_done) or raises :class:`PowInterrupted`
+    when interrupted.
     """
     ih_hi, ih_lo = initial_hash_words(initial_hash)
     t_hi, t_lo = u64_from_int(target)
+    chunks = chunks_per_call
+    if tuner is not None:
+        # one octave around the default: keeps the compiled-shape
+        # ladder short and stops compile-contaminated observations
+        # from swinging the slab size between extremes
+        chunks = tuner.suggest(tuner_kind, chunks_per_call,
+                               lo=max(1, chunks_per_call // 2),
+                               hi=chunks_per_call * 2)
 
     def search_once(b_hi, b_lo):
         return pow_search_jit(ih_hi, ih_lo, t_hi, t_lo, b_hi, b_lo,
-                              lanes, chunks_per_call, variant)
+                              lanes, chunks, variant)
+
+    on_slab = None
+    if tuner is not None:
+        on_slab = lambda dt: tuner.record(tuner_kind, chunks, dt)  # noqa: E731
 
     return _run_host_driver(
         search_once, initial_hash, target, start_nonce=start_nonce,
-        trials_per_call_step=lanes, should_stop=should_stop)
+        trials_per_call_step=lanes, should_stop=should_stop,
+        on_slab=on_slab)
 
 
 @jax.jit

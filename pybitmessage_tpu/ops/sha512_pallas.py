@@ -119,6 +119,37 @@ def _broadcast_pair(pair, shape):
     return (jnp.broadcast_to(pair[0], shape), jnp.broadcast_to(pair[1], shape))
 
 
+def _double_sha512_tile(ih_pair, n_hi, n_lo):
+    """Double-SHA512 trial values for a tile of nonces.
+
+    ``ih_pair(i) -> (hi, lo)`` may return shape-() scalars (the single
+    and per-object batch kernels read them straight from SMEM) or
+    full-tile arrays (the packed kernel's per-lane object identity).
+    Scalar initial-hash words are NOT broadcast to the lane shape here:
+    every message-schedule word whose inputs are all uniform across the
+    lane axis (w17/w19/w21 outright, plus the sigma contributions of
+    w1..w15 feeding later extensions) then stays a shape-() value the
+    compiler evaluates once per object on the scalar core, instead of
+    redundantly per lane on the VPU — the schedule-hoisting lever.
+    Mixed scalar/tile pairs combine through ordinary broadcasting in
+    ``_add``/``_xor3``.
+    """
+    zero = jnp.uint32(0)
+    w = [(n_hi, n_lo)]
+    w += [ih_pair(i) for i in range(8)]
+    w.append((jnp.uint32(0x80000000), zero))
+    w += [(zero, zero)] * 5
+    w.append((zero, jnp.uint32(576)))
+    h1 = _compress(w)
+
+    w2 = list(h1)
+    w2.append((jnp.uint32(0x80000000), zero))
+    w2 += [(zero, zero)] * 6
+    w2.append((zero, jnp.uint32(512)))
+    h2 = _compress(w2)
+    return h2[0]
+
+
 def _search_step(ih_pair, base_hi, base_lo, target_hi, target_lo,
                  step, rows: int):
     """One grid step's search over a (rows, 128) nonce tile.
@@ -136,24 +167,7 @@ def _search_step(ih_pair, base_hi, base_lo, target_hi, target_lo,
     carry = (lo < base_lo).astype(U32)  # offset+lane < 2^32 per slab
     hi = jnp.broadcast_to(base_hi, shape) + carry
 
-    zero = jnp.zeros(shape, dtype=U32)
-
-    def bcs(x):
-        return jnp.broadcast_to(x, shape)
-
-    w = [(hi, lo)]
-    w += [(bcs(ih_pair(i)[0]), bcs(ih_pair(i)[1])) for i in range(8)]
-    w.append((bcs(jnp.uint32(0x80000000)), zero))
-    w += [(zero, zero)] * 5
-    w.append((zero, bcs(jnp.uint32(576))))
-    h1 = _compress(w)
-
-    w2 = list(h1)
-    w2.append((bcs(jnp.uint32(0x80000000)), zero))
-    w2 += [(zero, zero)] * 6
-    w2.append((zero, bcs(jnp.uint32(512))))
-    h2 = _compress(w2)
-    v_hi, v_lo = h2[0]
+    v_hi, v_lo = _double_sha512_tile(ih_pair, hi, lo)
 
     ok = (v_hi < target_hi) | ((v_hi == target_hi) & (v_lo <= target_lo))
     # winner = smallest lane index with a hit.  Mosaic has no unsigned
@@ -252,6 +266,170 @@ def _batch_kernel(ih_ref, base_ref, target_ref, out_ref, flag_ref,
             out_ref[obj, 0] = jnp.uint32(step + 1)
             out_ref[obj, 1] = n_hi
             out_ref[obj, 2] = n_lo
+
+
+def _packed_kernel(ih_hi_ref, ih_lo_ref, t_hi_ref, t_lo_ref,
+                   b_hi_ref, b_lo_ref, base_ref, out_ref, flag_ref,
+                   *, rows: int, pack: int, unroll: int = 1):
+    """Multi-object SLAB PACKING: grid = (groups, chunks).  Each grid
+    step evaluates ONE (rows, 128) tile shared by ``pack`` objects
+    (``rows // pack`` rows each), and the leading grid axis carries
+    independent groups — one launch covers ``groups * pack`` pending
+    objects, so a broadcast storm of tiny objects fills the whole grid
+    instead of paying a launch + host sync per object (the ISSUE 2
+    tentpole: BENCH_r05 measured the storm at 35.7M H/s, 5.7x below
+    kernel peak, dominated by per-launch overhead).
+
+    Per-lane object identity (initial-hash words, targets, nonce
+    bases) is baked into pre-gathered VMEM tiles streamed per group;
+    ``base_ref`` (SMEM (groups, pack, 2)) carries scalar nonce bases
+    for winner recovery.  Winners resolve per object via a masked min
+    over the object's rows; per-object SMEM flags keep the first
+    winner and a per-group counter skips the group's remaining steps
+    once every member has hit (storm groups usually exit within a few
+    steps).  Solved objects' rows keep hashing until their group
+    finishes — waste bounded by the group, which the planner keeps
+    difficulty-homogeneous by sorting.
+    """
+    grp = pl.program_id(0)
+    step = pl.program_id(1)
+    rpo = rows // pack
+    shape = (rows, LANE_COLS)
+
+    @pl.when(step == 0)
+    def _init():
+        flag_ref[grp, pack] = jnp.int32(0)
+        for k in range(pack):
+            flag_ref[grp, k] = jnp.int32(0)
+            out_ref[grp, k, 0] = jnp.uint32(0)
+            out_ref[grp, k, 1] = jnp.uint32(0)
+            out_ref[grp, k, 2] = jnp.uint32(0)
+
+    @pl.when(flag_ref[grp, pack] < pack)
+    def do_search():
+        row = jax.lax.broadcasted_iota(jnp.int32, shape, 0)
+        # lane index WITHIN the owning object: (r % rpo)*128 + c
+        local = ((jax.lax.broadcasted_iota(U32, shape, 0)
+                  % jnp.uint32(rpo)) * jnp.uint32(LANE_COLS)
+                 + jax.lax.broadcasted_iota(U32, shape, 1))
+        local_i = local.astype(jnp.int32)
+        big = jnp.int32(0x7FFFFFFF)
+        b_hi = b_hi_ref[0]
+        b_lo = b_lo_ref[0]
+        t_hi = t_hi_ref[0]
+        t_lo = t_lo_ref[0]
+        for u in range(unroll):
+            offset = (jnp.uint32(step) * jnp.uint32(unroll)
+                      + jnp.uint32(u)) * jnp.uint32(rpo * LANE_COLS)
+            lo = b_lo + offset
+            carry = (lo < b_lo).astype(U32)
+            hi = b_hi + carry
+            v_hi, v_lo = _double_sha512_tile(
+                lambda i: (ih_hi_ref[0, i], ih_lo_ref[0, i]), hi, lo)
+            ok = (v_hi < t_hi) | ((v_hi == t_hi) & (v_lo <= t_lo))
+            cand = jnp.where(ok, local_i, big)
+            for k in range(pack):
+                @pl.when(flag_ref[grp, k] == 0)
+                def _check(k=k, cand=cand, offset=offset):
+                    mask = ((row >= k * rpo) & (row < (k + 1) * rpo))
+                    win = jnp.min(jnp.where(mask, cand, big))
+
+                    @pl.when(win != big)
+                    def _record():
+                        wl = (base_ref[grp, k, 1] + offset
+                              + win.astype(U32))
+                        wc = (wl < base_ref[grp, k, 1]).astype(U32)
+                        out_ref[grp, k, 0] = jnp.uint32(step + 1)
+                        out_ref[grp, k, 1] = base_ref[grp, k, 0] + wc
+                        out_ref[grp, k, 2] = wl
+                        flag_ref[grp, k] = jnp.int32(1)
+                        flag_ref[grp, pack] = flag_ref[grp, pack] + 1
+
+
+@functools.partial(jax.jit, static_argnames=("rows", "chunks", "pack",
+                                             "unroll", "interpret"),
+                   donate_argnums=(1, 2))
+def pallas_packed_search(ih_words, bases, targets, rows: int = DEFAULT_ROWS,
+                         chunks: int = 16, pack: int = 16,
+                         unroll: int = 1, interpret: bool = False):
+    """Search B = groups*pack objects' nonce ranges in ONE launch.
+
+    ``bases``/``targets`` are DONATED: the pipeline uploads fresh
+    per-launch arrays (they change every dispatch), so XLA recycles
+    the previous launch's buffers instead of allocating — callers must
+    not reuse the arrays they pass in.
+
+    ``ih_words``: (B, 8, 2) uint32; ``bases``/``targets``: (B, 2),
+    with B a multiple of ``pack``.  Objects are tiled ``pack`` per
+    (rows, 128) grid-step tile (object k of a group owns rows
+    [k*rows/pack, (k+1)*rows/pack)) and groups ride the leading grid
+    axis; object b searches nonces ``bases[b] + step*unroll*rpo*128 +
+    local_lane``.  Returns a (B, 3) uint32 array of ``[hit_step + 1,
+    nonce_hi, nonce_lo]`` rows (first column 0 = no hit this launch).
+
+    The per-lane gathers (object id -> ih words / target / base) run
+    in XLA *outside* the kernel, once per launch — Mosaic only ever
+    sees dense elementwise tiles, DMA-streamed per group.
+    """
+    if rows % pack:
+        raise ValueError("rows %d not divisible by pack %d" % (rows, pack))
+    n_obj = ih_words.shape[0]
+    if n_obj % pack:
+        raise ValueError("batch %d not divisible by pack %d"
+                         % (n_obj, pack))
+    groups = n_obj // pack
+    rpo = rows // pack
+    shape = (rows, LANE_COLS)
+
+    def tile(col):          # (G, rows) -> (G, rows, 128)
+        return jnp.broadcast_to(col[:, :, None], (groups,) + shape)
+
+    # (G, pack, 8, 2) -> per-row object identity (G, rows, 8, 2)
+    ihw = jnp.repeat(ih_words.reshape(groups, pack, 8, 2), rpo, axis=1)
+    ih_hi_t = jnp.broadcast_to(
+        ihw[..., 0].transpose(0, 2, 1)[:, :, :, None],
+        (groups, 8) + shape)
+    ih_lo_t = jnp.broadcast_to(
+        ihw[..., 1].transpose(0, 2, 1)[:, :, :, None],
+        (groups, 8) + shape)
+    tg = jnp.repeat(targets.reshape(groups, pack, 2), rpo, axis=1)
+    t_hi_t = tile(tg[..., 0])
+    t_lo_t = tile(tg[..., 1])
+    local = ((jax.lax.broadcasted_iota(U32, shape, 0) % jnp.uint32(rpo))
+             * jnp.uint32(LANE_COLS)
+             + jax.lax.broadcasted_iota(U32, shape, 1))
+    bg = jnp.repeat(bases.reshape(groups, pack, 2), rpo, axis=1)
+    b_lo_obj = tile(bg[..., 1])
+    b_lo_t = b_lo_obj + local
+    b_hi_t = tile(bg[..., 0]) + (b_lo_t < b_lo_obj).astype(U32)
+
+    kernel = functools.partial(_packed_kernel, rows=rows, pack=pack,
+                               unroll=unroll)
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((groups, pack, 3), U32),
+        grid=(groups, chunks),
+        in_specs=[
+            pl.BlockSpec((1, 8) + shape, lambda g, s: (g, 0, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 8) + shape, lambda g, s: (g, 0, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1,) + shape, lambda g, s: (g, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1,) + shape, lambda g, s: (g, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1,) + shape, lambda g, s: (g, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1,) + shape, lambda g, s: (g, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+        ],
+        out_specs=pl.BlockSpec(memory_space=pltpu.SMEM),
+        scratch_shapes=[pltpu.SMEM((groups, pack + 1), jnp.int32)],
+        interpret=interpret,
+    )(ih_hi_t, ih_lo_t, t_hi_t, t_lo_t, b_hi_t, b_lo_t,
+      bases.reshape(groups, pack, 2))
+    return out.reshape(n_obj, 3)
 
 
 @functools.partial(jax.jit, static_argnames=("rows", "chunks", "interpret",
@@ -501,7 +679,8 @@ def solve(initial_hash: bytes, target: int, *,
           start_nonce: int = 0, rows: int = DEFAULT_ROWS,
           chunks_per_call: int = DEFAULT_CHUNKS,
           unroll: int = DEFAULT_UNROLL, should_stop=None,
-          interpret: bool = False):
+          interpret: bool = False, tuner=None,
+          tuner_kind: str = "pallas_single"):
     """Find a nonce whose trial value is <= target (Pallas backend).
 
     Same contract as :func:`pow_search.solve`: returns
@@ -527,7 +706,14 @@ def solve(initial_hash: bytes, target: int, *,
     target &= (1 << 64) - 1
     target_arr = jnp.array([target >> 32, target & 0xFFFFFFFF], dtype=U32)
 
-    trials_per_slab = rows * LANE_COLS * chunks_per_call * unroll
+    chunks = chunks_per_call
+    if tuner is not None:
+        # measured-latency slab sizing; the octave bound keeps Mosaic
+        # recompiles (one per distinct chunk count) rare
+        chunks = tuner.suggest(tuner_kind, chunks_per_call,
+                               lo=chunks_per_call // 2,
+                               hi=chunks_per_call * 2)
+    trials_per_slab = rows * LANE_COLS * chunks * unroll
     mask64 = (1 << 64) - 1
 
     def launch(base_int: int):
@@ -538,7 +724,7 @@ def solve(initial_hash: bytes, target: int, *,
         base = np.array([(base_int >> 32) & 0xFFFFFFFF,
                          base_int & 0xFFFFFFFF], dtype=np.uint32)
         return pallas_search(ih_words, base, target_arr, rows=rows,
-                             chunks=chunks_per_call, unroll=unroll,
+                             chunks=chunks, unroll=unroll,
                              interpret=interpret)
 
     def harvest(found_dev, nonce_dev):
@@ -557,24 +743,31 @@ def solve(initial_hash: bytes, target: int, *,
     # Double-buffered host loop: slab N+1 is dispatched BEFORE slab N's
     # results are pulled, so the host-side transfer/bookkeeping gap
     # hides behind device compute on long (multi-slab) searches.
+    import time as _time
+
     base = start_nonce & mask64
     trials = 0
-    pending = None  # (found_dev, nonce_dev)
+    pending = None  # ((found_dev, nonce_dev), dispatch_time)
     while True:
         if should_stop is not None and should_stop():
             # the in-flight slab may already hold the answer — check
             # before discarding ~16.7M trials of completed device work
             if pending is not None:
                 trials += trials_per_slab
-                nonce = harvest(*pending)
+                nonce = harvest(*pending[0])
                 if nonce is not None:
                     return nonce, trials
             raise PowInterrupted("Pallas PoW interrupted by shutdown")
-        current = launch(base)
+        current = (launch(base), _time.monotonic())
         base = (base + trials_per_slab) & mask64
         if pending is not None:
             trials += trials_per_slab
-            nonce = harvest(*pending)
+            nonce = harvest(*pending[0])
+            if tuner is not None:
+                # dispatch -> harvested wall of the pending slab: the
+                # cadence the autotuner steers toward target_seconds
+                tuner.record(tuner_kind, chunks,
+                             _time.monotonic() - pending[1])
             if nonce is not None:
                 return nonce, trials
         pending = current

@@ -1,0 +1,23 @@
+"""JAX version compatibility for the sharding layer.
+
+``shard_map`` graduated from ``jax.experimental.shard_map`` to the
+top-level ``jax`` namespace, renaming ``check_rep`` to ``check_vma``
+along the way.  The container images this repo targets span both
+eras, so the sharded PoW tiers import through this shim instead of
+pinning one spelling.
+"""
+
+from __future__ import annotations
+
+try:                                   # jax >= 0.5: top-level export
+    from jax import shard_map as _shard_map
+    _CHECK_KW = "check_vma"
+except ImportError:                    # jax 0.4.x: experimental
+    from jax.experimental.shard_map import shard_map as _shard_map
+    _CHECK_KW = "check_rep"
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True):
+    """Version-agnostic ``shard_map`` (keyword-only, like the callers)."""
+    return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, **{_CHECK_KW: check_vma})

@@ -34,7 +34,7 @@ from typing import Callable
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+from ._compat import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..ops.sha512_jax import DEFAULT_VARIANT, trial_values
@@ -377,23 +377,38 @@ def pallas_sharded_solve_batch(items, mesh: Mesh, *,
         ih_words = jnp.stack([_ih_words_arr(ih) for ih in ihs])
         t_arr = jnp.stack([_pair_arr(t) for t in targets])
 
+        # trials granularity of one reported hit step, per impl: a
+        # pallas grid step covers `unroll` tiles, an XLA chunk covers
+        # one
+        step_trials = rows * LANE_COLS * (
+            unroll if impl == "pallas" else 1)
         bases = [0] * group_objs
         trials = [0] * group_objs
         done = [i >= len(group) for i in range(group_objs)]
-        while not all(done):
-            if should_stop is not None and should_stop():
-                raise PowInterrupted(
-                    "sharded batched Pallas PoW interrupted")
+
+        def dispatch():
+            """Launch one pod slab for the group's live objects.
+
+            Bases advance optimistically at dispatch so the NEXT slab
+            can be issued before this one's flags are read back
+            (dispatch-ahead double buffering — host verification and
+            bookkeeping overlap device compute, the same pipeline as
+            the single-chip solve_batch)."""
+            live = [i for i in range(group_objs) if not done[i]]
             b_arr = jnp.stack([_pair_arr(b) for b in bases])
-            packed = np.asarray(fn(ih_words, b_arr, t_arr))
+            out = fn(ih_words, b_arr, t_arr)
+            for i in live:
+                bases[i] = (bases[i] + stride) & _MASK64
+            return out, live
+
+        def harvest(out_dev, live):
+            nonlocal t_arr
+            t0 = _time.monotonic()
+            packed = np.asarray(out_dev)          # the blocking fetch
+            _metrics.DEVICE_WAIT.observe(_time.monotonic() - t0)
             found, n_hi, n_lo = packed[:, 0], packed[:, 1], packed[:, 2]
             steps = packed[:, 3]
-            # trials granularity of one reported hit step, per impl:
-            # a pallas grid step covers `unroll` tiles, an XLA chunk
-            # covers one
-            step_trials = rows * LANE_COLS * (
-                unroll if impl == "pallas" else 1)
-            for i in range(group_objs):
+            for i in live:
                 if done[i]:
                     continue
                 if found[i]:
@@ -402,9 +417,6 @@ def pallas_sharded_solve_batch(items, mesh: Mesh, *,
                     # devices ran their full slab concurrently
                     trials[i] += (int(steps[i]) * step_trials
                                   + (nonce_devs - 1) * slab)
-                else:
-                    trials[i] += stride
-                if found[i]:
                     nonce = (int(n_hi[i]) << 32) | int(n_lo[i])
                     check = double_sha512(
                         nonce.to_bytes(8, "big") + ihs[i])
@@ -418,5 +430,32 @@ def pallas_sharded_solve_batch(items, mesh: Mesh, *,
                     t_arr = t_arr.at[i].set(
                         jnp.array([0xFFFFFFFF, 0xFFFFFFFF], dtype=U32))
                 else:
-                    bases[i] = (bases[i] + stride) & _MASK64
+                    trials[i] += stride
+
+        import time as _time
+
+        from ..pow import pipeline as _metrics
+
+        pending = None      # (device_out, live_snapshot)
+        while not all(done):
+            if should_stop is not None and should_stop():
+                if pending is not None:
+                    # the in-flight pod slab may hold answers — drain
+                    # before deciding to abandon the group
+                    harvest(*pending)
+                    pending = None
+                if all(done):
+                    break   # the drained slab finished the group
+                raise PowInterrupted(
+                    "sharded batched Pallas PoW interrupted")
+            current = dispatch()
+            _metrics.PIPELINE_DEPTH.set(2 if pending else 1)
+            if pending is not None:
+                _metrics.DISPATCH_AHEAD.observe(2)
+                harvest(*pending)
+            pending = current
+        # loop exits with every object done; a still-in-flight slab is
+        # pure speculation for a finished group (targets all flipped
+        # always-hit next launch) — abandoned unfetched
+        _metrics.PIPELINE_DEPTH.set(0)
     return results

@@ -19,7 +19,7 @@ from typing import Callable
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+from ._compat import shard_map
 
 from ..ops.pow_search import PowInterrupted, _run_host_driver
 from ..ops.sha512_jax import (DEFAULT_VARIANT, initial_hash_words,

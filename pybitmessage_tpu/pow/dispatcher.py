@@ -232,14 +232,18 @@ class PowDispatcher:
                                 **{"from": "tpu-batch",
                                    "to": "ladder"}).inc()
                 elif self._pallas_enabled and self._on_accelerator():
-                    # single chip: one Mosaic launch carries the whole
-                    # batch on a 2D (objects x chunks) grid with
-                    # per-object early exit
+                    # single chip: the async double-buffered pipeline
+                    # plans the launch shape (multi-object slab packing
+                    # for storms, the per-object (objects x chunks)
+                    # batch grid for network difficulty, a synchronous
+                    # latency-optimal launch for one tiny object) and
+                    # keeps slabs dispatched ahead of harvest
                     try:
-                        from ..ops.sha512_pallas import solve_batch
+                        from .pipeline import solve_batch_pipelined
                         self.last_backend = "tpu-pallas-batch"
                         ATTEMPTS.labels(backend=self.last_backend).inc()
-                        results = solve_batch(items, should_stop=should_stop)
+                        results = solve_batch_pipelined(
+                            items, should_stop=should_stop)
                     except PowInterrupted:
                         raise
                     except Exception:
@@ -252,6 +256,27 @@ class PowDispatcher:
                         self._pallas_enabled = False
                         FALLBACKS.labels(
                             **{"from": "tpu-pallas", "to": "ladder"}).inc()
+            if (results is None and len(items) == 1 and self._tpu_enabled
+                    and self._pallas_enabled and self._on_accelerator()
+                    and self._device_count() <= 1):
+                # degenerate case: ONE object.  If it is tiny (expected
+                # to finish inside the first small launch) the pipeline
+                # takes its latency-optimal synchronous path instead of
+                # paying a full production slab + speculative dispatch.
+                try:
+                    from .pipeline import plan_batch, solve_batch_pipelined
+                    if plan_batch(items).mode == "single-sync":
+                        self.last_backend = "tpu-pallas-batch"
+                        ATTEMPTS.labels(backend=self.last_backend).inc()
+                        results = solve_batch_pipelined(
+                            items, should_stop=should_stop)
+                except PowInterrupted:
+                    raise
+                except Exception:
+                    logger.exception(
+                        "pipelined single-object PoW failed; using the "
+                        "ladder")
+                    results = None
             if results is None:
                 results = [self._solve(ih, t, 0, should_stop)
                            for ih, t in items]
@@ -323,11 +348,13 @@ class PowDispatcher:
                     # proofofwork.py:288-325 / openclpow wiring
                     try:
                         from ..ops.sha512_pallas import solve as pl_solve
+                        from .pipeline import AUTOTUNER
                         self.last_backend = "tpu-pallas"
                         ATTEMPTS.labels(backend=self.last_backend).inc()
                         return pl_solve(initial_hash, target,
                                         start_nonce=start_nonce,
-                                        should_stop=should_stop)
+                                        should_stop=should_stop,
+                                        tuner=AUTOTUNER)
                     except PowInterrupted:
                         raise
                     except Exception:
@@ -339,10 +366,17 @@ class PowDispatcher:
                 from ..ops.pow_search import solve as tpu_solve
                 self.last_backend = "tpu"
                 ATTEMPTS.labels(backend=self.last_backend).inc()
+                kwargs = self._xla_kwargs()
+                if not self.tpu_kwargs:
+                    # no explicit powlanes/powchunks override: let the
+                    # measured-latency autotuner size the slab instead
+                    # of the hardcoded 2^19 x 64 constant
+                    from .pipeline import AUTOTUNER
+                    kwargs = dict(kwargs, tuner=AUTOTUNER)
                 return tpu_solve(initial_hash, target,
                                  start_nonce=start_nonce,
                                  should_stop=should_stop,
-                                 **self._xla_kwargs())
+                                 **kwargs)
             except PowInterrupted:
                 raise
             except Exception:

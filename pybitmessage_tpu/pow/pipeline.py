@@ -1,0 +1,680 @@
+"""Asynchronous double-buffered PoW execution pipeline (ISSUE 2).
+
+BENCH_r05 measured the device kernel at 202.9M H/s per chip while the
+batched-queue config aggregated only 135.6M H/s and the broadcast storm
+(10k tiny objects) collapsed to 35.7M H/s — the host pipeline was
+giving back most of the kernel's gains.  Three levers close the gap:
+
+1. **Multi-object slab packing** (``ops.sha512_pallas.
+   pallas_packed_search``): several pending objects share ONE device
+   slab along the lane axis with per-lane object identity and
+   per-object targets, so a storm of small objects fills the grid
+   instead of paying a full launch + host sync per object.
+2. **Dispatch-ahead double buffering** (:func:`_PipelineDriver.run`):
+   slab N+1 is issued before slab N's hit flags are read back, hiding
+   host verification/serialization behind device compute (the
+   sync-slab penalty: 136.6M vs 202.9M H/s).
+3. **Early-exit cadence autotuning** (:class:`SlabAutotuner`): slab
+   size (chunks per launch) is derived from *measured* slab latency so
+   the shutdown-poll interval stays near a target regardless of
+   hardware, instead of the hardcoded 2^19 x 64 constant.
+
+The planner (:func:`plan_batch`) chooses per batch between the packed
+kernel (many small objects), the per-object batch kernel (few large
+objects) and a latency-optimal synchronous single launch (the
+degenerate one-tiny-object case must not pay speculative dispatch).
+Every stage reports through ``observability.REGISTRY`` — device-busy
+fraction, dispatch-ahead depth, pack occupancy — per the conventions
+in docs/observability.md; see docs/pow_pipeline.md for the full
+architecture.
+
+On hosts without an accelerator (the CI virtual CPU mesh) the Mosaic
+kernels are replaced by an XLA equivalent with the identical
+(pack, 3)-row output contract (``impl="xla"``), so the planning,
+pipelining and metrics logic is fully exercised without a TPU —
+the same pattern ``parallel/pow_pallas_sharded.py`` uses.
+"""
+
+from __future__ import annotations
+
+import functools
+import logging
+import math
+import threading
+import time
+from collections import deque
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+from ..observability import DEFAULT_SIZE_BUCKETS, REGISTRY
+from ..ops.pow_search import PowInterrupted
+from ..ops.sha512_jax import double_sha512_trial
+from ..ops.sha512_pallas import (DEFAULT_ROWS, LANE_COLS,
+                                 pallas_packed_search)
+from ..ops.u64 import U32
+from ..utils.hashes import double_sha512
+
+logger = logging.getLogger("pybitmessage_tpu.pow")
+
+_MASK64 = (1 << 64) - 1
+#: always-hit target for pad slots (every trial value is <= 2^64-1)
+_ALWAYS_HIT = _MASK64
+
+DEVICE_BUSY = REGISTRY.gauge(
+    "pow_pipeline_device_busy_ratio",
+    "Fraction of the last pipelined solve's wall time the host spent "
+    "blocked on device results — a lower bound on true device "
+    "occupancy; the sync-path penalty shows up as this dropping")
+PIPELINE_DEPTH = REGISTRY.gauge(
+    "pow_pipeline_depth", "Slabs currently in flight (dispatch-ahead)")
+DISPATCH_AHEAD = REGISTRY.histogram(
+    "pow_pipeline_dispatch_ahead_size",
+    "In-flight slab count sampled at each harvest",
+    buckets=DEFAULT_SIZE_BUCKETS)
+DEVICE_WAIT = REGISTRY.histogram(
+    "pow_pipeline_device_wait_seconds",
+    "Blocking wait for one slab's results at harvest time")
+PACK_SIZE = REGISTRY.histogram(
+    "pow_pack_size",
+    "Live (non-pad, unsolved) objects sharing one packed slab launch",
+    buckets=DEFAULT_SIZE_BUCKETS)
+PACK_OCCUPANCY = REGISTRY.gauge(
+    "pow_pack_occupancy_ratio",
+    "Fraction of the last packed slab's lanes owned by live objects")
+PIPELINE_MODE = REGISTRY.counter(
+    "pow_pipeline_mode_total",
+    "Pipelined solve launches by execution mode", ("mode",))
+SLAB_SECONDS = REGISTRY.histogram(
+    "pow_slab_seconds",
+    "Wall latency of one device slab launch as seen by the pipeline "
+    "(dispatch to harvested) — the autotuner's input", ("kind",))
+AUTOTUNE_CHUNKS = REGISTRY.gauge(
+    "pow_slab_autotune_chunks",
+    "Chunks-per-launch the autotuner currently suggests", ("kind",))
+
+
+class SlabAutotuner:
+    """Derives slab size from measured latency (early-exit cadence).
+
+    Tracks an EWMA of seconds-per-grid-step per slab ``kind``
+    (``record`` takes the launch's TOTAL grid steps — chunks times
+    groups — so a 64-group packed storm launch and a 1-group
+    single-sync launch feed the same normalized signal) and suggests a
+    power-of-two chunk count whose expected slab latency is closest to
+    ``target_seconds`` — the hit-poll / shutdown-poll granularity.
+    Power-of-two quantization bounds the number of distinct compiled
+    shapes; the EWMA plus a 10x outlier clamp make one slow
+    observation (a fresh jit compile, a relay stall) decay instead of
+    permanently shrinking slabs.  Thread-safe: the dispatcher's
+    executor and the asyncio service may solve concurrently.
+    """
+
+    def __init__(self, *, target_seconds: float = 0.5,
+                 min_chunks: int = 4, max_chunks: int = 2048,
+                 alpha: float = 0.4):
+        self.target_seconds = target_seconds
+        self.min_chunks = min_chunks
+        self.max_chunks = max_chunks
+        self.alpha = alpha
+        self._per_chunk: dict[str, float] = {}
+        self._lock = threading.Lock()
+
+    def record(self, kind: str, units: int, seconds: float) -> None:
+        """Feed one measured slab (dispatch->harvest wall seconds).
+
+        ``units``: total grid steps of the launch (chunks x groups for
+        the grouped kernels, plain chunks for single-grid slabs).
+        """
+        if units <= 0 or seconds <= 0:
+            return
+        per = seconds / units
+        with self._lock:
+            prev = self._per_chunk.get(kind)
+            if prev is not None and per > 10 * prev:
+                # compile / relay-stall outlier: cap its influence so
+                # one bad slab cannot crater the suggestion
+                per = 10 * prev
+            self._per_chunk[kind] = per if prev is None else (
+                self.alpha * per + (1 - self.alpha) * prev)
+        SLAB_SECONDS.labels(kind=kind).observe(seconds)
+
+    def suggest(self, kind: str, default: int,
+                lo: int | None = None, hi: int | None = None,
+                groups: int = 1) -> int:
+        """Chunk count targeting ``target_seconds`` per slab of
+        ``groups`` grid groups.
+
+        ``lo``/``hi`` narrow the ladder per call site — Mosaic kernels
+        pass tight bounds because every new chunk count is a fresh
+        (expensive) compile, while the XLA tier can roam a wider
+        range.
+        """
+        with self._lock:
+            per = self._per_chunk.get(kind)
+        if per is None or per <= 0:
+            return default
+        raw = self.target_seconds / (per * max(groups, 1))
+        chunks = 1 << max(0, round(math.log2(max(raw, 1.0))))
+        chunks = max(lo or self.min_chunks,
+                     min(hi or self.max_chunks, chunks))
+        AUTOTUNE_CHUNKS.labels(kind=kind).set(chunks)
+        return chunks
+
+    def seconds_per_chunk(self, kind: str) -> float | None:
+        """EWMA seconds per grid step (None until first record)."""
+        with self._lock:
+            return self._per_chunk.get(kind)
+
+
+#: process-wide autotuner — solve paths share latency knowledge
+AUTOTUNER = SlabAutotuner()
+
+
+def default_impl() -> str:
+    """"pallas" on an accelerator backend, "xla" on host CPU."""
+    try:
+        return "pallas" if jax.default_backend() != "cpu" else "xla"
+    except Exception:  # pragma: no cover - backend probe failure
+        return "xla"
+
+
+def expected_trials(target: int) -> float:
+    """Mean trials to beat ``target`` (trial values uniform on u64)."""
+    return 2.0 ** 64 / max(target & _MASK64, 1)
+
+
+# ---------------------------------------------------------------------------
+# XLA stand-in for the packed Mosaic kernel (CPU mesh / CI)
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.jit, static_argnames=("lanes", "chunks"))
+def _packed_search_xla(ih_words, bases, targets, lanes: int, chunks: int):
+    """Same output contract as ``pallas_packed_search`` in pure XLA.
+
+    Each object scans ``chunks`` chunks of ``lanes`` consecutive
+    nonces (``lanes`` = the object's per-step lane share) — identical
+    ranges and winner ordering to the packed/batch kernels, so hosts
+    without Mosaic (the CI CPU mesh) exercise the exact pipeline and
+    planner logic.  Returns (B, 3) uint32 rows ``[hit_step + 1,
+    nonce_hi, nonce_lo]``.
+    """
+
+    def one(ihw, base, target):
+        lane = jnp.arange(lanes, dtype=U32)
+
+        def step(carry, _):
+            b_hi, b_lo = carry
+            lo = b_lo + lane
+            c = (lo < b_lo).astype(U32)
+            hi = jnp.broadcast_to(b_hi, lo.shape) + c
+            v_hi, v_lo = double_sha512_trial(hi, lo, ihw[:, 0], ihw[:, 1])
+            ok = (v_hi < target[0]) | ((v_hi == target[0])
+                                       & (v_lo <= target[1]))
+            idx = jnp.argmax(ok)
+            n_lo = b_lo + jnp.uint32(lanes)
+            n_hi = b_hi + (n_lo < b_lo).astype(U32)
+            return (n_hi, n_lo), (jnp.any(ok), hi[idx], lo[idx])
+
+        _, (hits, nhs, nls) = jax.lax.scan(
+            step, (base[0], base[1]), None, length=chunks)
+        first = jnp.argmax(hits)
+        found = jnp.any(hits)
+        step1 = jnp.where(found, first + 1, 0).astype(U32)
+        return jnp.stack([step1, nhs[first], nls[first]])
+
+    return jax.vmap(one)(ih_words, bases, targets)
+
+
+# ---------------------------------------------------------------------------
+# planning
+# ---------------------------------------------------------------------------
+
+#: pack-factor ladder: rows//pack stays >= 8 (one VPU sublane) at the
+#: production row count
+PACK_CHOICES = (16, 8, 4, 2)
+#: chunk budget of one packed launch before autotuning kicks in; at
+#: pack=16 that is 8*128*chunks trials per object per launch
+DEFAULT_PACKED_CHUNKS = 64
+#: per-object batch geometry (mirrors sha512_pallas.BATCH_*)
+DEFAULT_BATCH_CHUNKS = 64
+#: leading-grid-axis cap of one packed launch: up to 64 tiles *
+#: pack objects ride one kernel call (the storm's launch-overhead
+#: amortization); group counts round up to powers of two so the
+#: compile cache stays a short ladder per pack
+PACKED_GROUPS_MAX = 64
+#: a single object expected to finish inside this many full-tile grid
+#: steps takes the latency-optimal synchronous path — speculative
+#: dispatch-ahead would only add latency (the degenerate case)
+SYNC_SINGLE_STEPS = 8
+
+
+class BatchPlan:
+    """Execution plan for one pipelined batch (see :func:`plan_batch`)."""
+
+    __slots__ = ("mode", "pack", "chunks", "order")
+
+    def __init__(self, mode: str, pack: int, chunks: int, order):
+        self.mode = mode        # "packed" | "batched" | "single-sync"
+        self.pack = pack        # objects per slab (packed mode)
+        self.chunks = chunks    # grid steps per launch
+        self.order = order      # item indices, difficulty-sorted
+
+    def __repr__(self):  # pragma: no cover - debug aid
+        return ("BatchPlan(mode=%r, pack=%d, chunks=%d, n=%d)"
+                % (self.mode, self.pack, self.chunks, len(self.order)))
+
+
+def plan_batch(items, *, rows: int = DEFAULT_ROWS, unroll: int = 1,
+               autotuner: SlabAutotuner | None = None) -> BatchPlan:
+    """Choose packing and slab geometry from the batch's difficulty.
+
+    The pack factor is sized so one launch covers roughly every
+    object's expected work: tiny (storm) objects pack 16 per slab,
+    network-default objects keep whole tiles (pack=1 -> the per-object
+    batch kernel), and a single small object degenerates to one
+    synchronous latency-optimal launch.  Objects are difficulty-sorted
+    so each packed group is homogeneous (a straggler would otherwise
+    hold its whole group's rows live).
+    """
+    autotuner = autotuner or AUTOTUNER
+    n = len(items)
+    exp = [expected_trials(t) for _, t in items]
+    tile_step = rows * LANE_COLS * unroll      # full-tile trials/step
+    if n == 1 and exp[0] <= SYNC_SINGLE_STEPS * tile_step:
+        chunks = autotuner.suggest("packed", SYNC_SINGLE_STEPS,
+                                   lo=4, hi=SYNC_SINGLE_STEPS, groups=1)
+        return BatchPlan("single-sync", 1, chunks, [0])
+    order = sorted(range(n), key=lambda i: exp[i])
+    med = sorted(exp)[n // 2]
+    # tight chunk ladder: every new chunk count is a fresh Mosaic
+    # compile, so the autotuner only moves within one octave up/down.
+    # groups estimated at the max pack factor (the common packed case)
+    # so the per-grid-step EWMA scales to this launch's width
+    est_groups = _pow2_at_least(-(-n // PACK_CHOICES[0]),
+                                PACKED_GROUPS_MAX)
+    chunks = autotuner.suggest("packed", DEFAULT_PACKED_CHUNKS,
+                               lo=DEFAULT_PACKED_CHUNKS // 2,
+                               hi=DEFAULT_PACKED_CHUNKS * 2,
+                               groups=est_groups)
+    pack = 1
+    for p in PACK_CHOICES:
+        # with pack p each object gets chunks*(rows/p)*128*unroll
+        # trials per launch; take the largest p that still covers the
+        # median object's expected work in ~one launch
+        if p <= n and med * p <= chunks * tile_step:
+            pack = p
+            break
+    if pack == 1:
+        from ..ops.sha512_pallas import BATCH_OBJS
+        return BatchPlan(
+            "batched", 1,
+            autotuner.suggest("batch", DEFAULT_BATCH_CHUNKS,
+                              lo=DEFAULT_BATCH_CHUNKS // 2,
+                              hi=DEFAULT_BATCH_CHUNKS * 2,
+                              groups=BATCH_OBJS), order)
+    return BatchPlan("packed", pack, chunks, order)
+
+
+# ---------------------------------------------------------------------------
+# dispatch-ahead driver
+# ---------------------------------------------------------------------------
+
+
+class _PipelineDriver:
+    """Generic dispatch-ahead loop: keep up to ``depth`` slabs in
+    flight, harvesting the oldest while newer ones run on device.
+
+    ``next_launch()`` returns an opaque (tag, device_future) pair or
+    None when no work remains; ``harvest(tag, host_result)`` consumes
+    one finished slab.  ``fetch`` pulls a device value to the host
+    (the blocking transfer whose wait time is the device-busy proxy).
+    """
+
+    def __init__(self, *, depth: int = 2,
+                 should_stop: Callable[[], bool] | None = None,
+                 fetch=None):
+        import numpy as np
+        self.depth = max(1, depth)
+        self.should_stop = should_stop
+        self.fetch = fetch or np.asarray
+        self.wait_seconds = 0.0
+        self.wall_seconds = 0.0
+        self.slabs = 0
+
+    def run(self, next_launch, harvest, done=None) -> None:
+        inflight: deque = deque()
+        t_start = time.monotonic()
+        try:
+            while True:
+                if done is not None and done():
+                    # every result is in: any remaining in-flight slab
+                    # is pure speculation — abandon it unfetched (the
+                    # device finishes it in the background) instead of
+                    # paying a blocking readback for nothing
+                    inflight.clear()
+                    break
+                if self.should_stop is not None and self.should_stop():
+                    # drain what is already in flight — a pending slab
+                    # may hold the answer the caller checkpoints on
+                    while inflight:
+                        tag, dev = inflight.popleft()
+                        harvest(tag, self.fetch(dev))
+                    raise PowInterrupted("pipelined PoW interrupted")
+                while len(inflight) < self.depth:
+                    nxt = next_launch()
+                    if nxt is None:
+                        break
+                    inflight.append(nxt)
+                    self.slabs += 1
+                    PIPELINE_DEPTH.set(len(inflight))
+                if not inflight:
+                    break
+                DISPATCH_AHEAD.observe(len(inflight))
+                tag, dev = inflight.popleft()
+                t0 = time.monotonic()
+                host = self.fetch(dev)
+                dt = time.monotonic() - t0
+                self.wait_seconds += dt
+                DEVICE_WAIT.observe(dt)
+                PIPELINE_DEPTH.set(len(inflight))
+                harvest(tag, host)
+        finally:
+            PIPELINE_DEPTH.set(0)
+            self.wall_seconds = max(time.monotonic() - t_start, 1e-9)
+            DEVICE_BUSY.set(self.busy_ratio)
+
+    @property
+    def busy_ratio(self) -> float:
+        if self.wall_seconds <= 0:
+            return 0.0
+        return min(self.wait_seconds / self.wall_seconds, 1.0)
+
+
+# ---------------------------------------------------------------------------
+# the pipelined batch solve (production entry)
+# ---------------------------------------------------------------------------
+
+
+class _LaunchGroup:
+    """Host state for one launch-wide slab group (``width`` objects)."""
+
+    __slots__ = ("idx", "ih_words", "targets", "t_arr", "bases",
+                 "trials", "done", "launches", "width")
+
+    def __init__(self, items, idx, width):
+        import numpy as np
+
+        pad = width - len(idx)
+        ihs = [items[i][0] for i in idx] + [b"\x00" * 64] * pad
+        self.targets = ([items[i][1] & _MASK64 for i in idx]
+                        + [_ALWAYS_HIT] * pad)
+        words = [[int.from_bytes(ih[j:j + 8], "big")
+                  for j in range(0, 64, 8)] for ih in ihs]
+        self.ih_words = jnp.array(
+            [[[w >> 32, w & 0xFFFFFFFF] for w in ws] for ws in words],
+            dtype=U32)
+        self.t_arr = np.array(
+            [[t >> 32, t & 0xFFFFFFFF] for t in self.targets],
+            dtype=np.uint32)
+        self.idx = list(idx)
+        self.width = width
+        self.bases = [0] * width
+        self.trials = [0] * width
+        self.done = [i >= len(idx) for i in range(width)]
+        self.launches = 0
+
+    @property
+    def finished(self) -> bool:
+        return all(self.done)
+
+    def live(self) -> int:
+        return sum(1 for d in self.done if not d)
+
+
+def _pow2_at_least(n: int, cap: int) -> int:
+    p = 1
+    while p < n and p < cap:
+        p *= 2
+    return min(p, cap)
+
+
+def solve_batch_pipelined(items, *, rows: int = DEFAULT_ROWS,
+                          unroll: int = 1, depth: int = 2,
+                          impl: str | None = None,
+                          interpret: bool = False,
+                          autotuner: SlabAutotuner | None = None,
+                          plan: BatchPlan | None = None,
+                          stats: dict | None = None,
+                          should_stop: Callable[[], bool] | None = None):
+    """Solve ``[(initial_hash, target), ...]`` through the async
+    double-buffered pipeline.  Returns ``[(nonce, trials), ...]``
+    aligned with ``items``; raises :class:`PowInterrupted` on
+    shutdown.
+
+    Mode selection (see :func:`plan_batch`): a storm of small objects
+    runs packed (up to ``PACKED_GROUPS_MAX * pack`` objects per
+    launch), network-difficulty batches run the per-object batch
+    kernel geometry (full tile per object), and a single tiny object
+    takes one synchronous latency-optimal launch with no speculative
+    dispatch.  Every returned nonce is host re-verified.  ``stats``
+    (optional dict) receives executed-trials/launch/wall accounting:
+    per-object ``trials`` in the results credit only the lanes the
+    object itself searched, while ``stats["executed_trials"]``
+    estimates total device hashing including straggler and pad waste —
+    the two diverge exactly where packing removes waste.
+    """
+    import numpy as np
+
+    n = len(items)
+    if n == 0:
+        return []
+    if impl is None:
+        impl = default_impl()
+    autotuner = autotuner or AUTOTUNER
+    if plan is None:
+        plan = plan_batch(items, rows=rows, unroll=unroll,
+                          autotuner=autotuner)
+    PIPELINE_MODE.labels(mode=plan.mode).inc()
+
+    if plan.mode == "single-sync":
+        return [_solve_single_sync(items[0], rows=rows, unroll=unroll,
+                                   chunks=plan.chunks, impl=impl,
+                                   interpret=interpret,
+                                   autotuner=autotuner,
+                                   should_stop=should_stop)]
+
+    if plan.mode == "packed":
+        pack = plan.pack
+        # one launch carries groups*pack objects on the leading grid
+        # axis — the storm's launch-overhead amortization
+        n_groups = _pow2_at_least(-(-n // pack), PACKED_GROUPS_MAX)
+        width = n_groups * pack
+        step_trials = (rows // pack) * LANE_COLS * unroll
+        kind = "packed"
+    else:
+        from ..ops.sha512_pallas import BATCH_OBJS, BATCH_UNROLL
+        pack = 1
+        width = BATCH_OBJS
+        unroll = BATCH_UNROLL if impl == "pallas" else unroll
+        step_trials = rows * LANE_COLS * unroll
+        kind = "batch"
+    slab_trials = step_trials * plan.chunks     # per object per launch
+
+    groups = [
+        _LaunchGroup(items, plan.order[s:s + width], width)
+        for s in range(0, n, width)
+    ]
+    results: list = [None] * n
+    executed = {"trials": 0, "launches": 0}
+
+    def search(g: _LaunchGroup):
+        bases = np.array(
+            [[(b >> 32) & 0xFFFFFFFF, b & 0xFFFFFFFF] for b in g.bases],
+            dtype=np.uint32)
+        if impl != "pallas":
+            return _packed_search_xla(
+                g.ih_words, jnp.asarray(bases), jnp.asarray(g.t_arr),
+                lanes=step_trials, chunks=plan.chunks)
+        if plan.mode == "packed":
+            return pallas_packed_search(
+                g.ih_words, jnp.asarray(bases), jnp.asarray(g.t_arr),
+                rows=rows, chunks=plan.chunks, pack=pack, unroll=unroll,
+                interpret=interpret)
+        from ..ops.sha512_pallas import pallas_batch_search
+        out = pallas_batch_search(
+            g.ih_words, jnp.asarray(bases), jnp.asarray(g.t_arr),
+            rows=rows, chunks=plan.chunks, unroll=unroll,
+            interpret=interpret)
+        return out
+
+    rr = {"i": 0}
+    inflight_groups: set = set()
+
+    def next_launch():
+        cand = None
+        # round-robin over unfinished groups without an in-flight slab
+        for off in range(len(groups)):
+            g = groups[(rr["i"] + off) % len(groups)]
+            if not g.finished and id(g) not in inflight_groups:
+                cand = g
+                rr["i"] = (rr["i"] + off + 1) % len(groups)
+                break
+        if cand is None:
+            # speculate one slab ahead on a group that already proved
+            # it needs more than one launch
+            for g in groups:
+                if not g.finished and g.launches >= 1:
+                    cand = g
+                    break
+        if cand is None:
+            return None
+        if plan.mode == "packed":
+            # pack statistics describe lane sharing, which only the
+            # packed kernel does — batched launches must not dilute
+            # them (docs/observability.md semantics)
+            live = cand.live()
+            PACK_SIZE.observe(live)
+            PACK_OCCUPANCY.set(live / cand.width)
+        t0 = time.monotonic()
+        out = search(cand)
+        inflight_groups.add(id(cand))
+        cand.launches += 1
+        executed["launches"] += 1
+        for k in range(cand.width):
+            if not cand.done[k]:
+                cand.bases[k] = (cand.bases[k] + slab_trials) & _MASK64
+        return ((cand, t0), out)
+
+    def harvest(tag, out):
+        g, t0 = tag
+        inflight_groups.discard(id(g))
+        # normalize by the launch's total grid steps so storm-wide and
+        # narrow launches feed one per-step EWMA
+        autotuner.record(kind, plan.chunks * (g.width // pack),
+                         time.monotonic() - t0)
+        for k in range(g.width):
+            if g.done[k]:
+                # solved/pad slots still executed one always-hit step
+                executed["trials"] += step_trials
+                continue
+            step1 = int(out[k, 0])
+            if step1:
+                g.trials[k] += step1 * step_trials
+                executed["trials"] += step1 * step_trials
+                nonce = (int(out[k, 1]) << 32) | int(out[k, 2])
+                ih = items[g.idx[k]][0]
+                check = double_sha512(nonce.to_bytes(8, "big") + ih)
+                if int.from_bytes(check[:8], "big") > g.targets[k]:
+                    raise ArithmeticError(
+                        "accelerator returned an invalid PoW nonce")
+                results[g.idx[k]] = (nonce, g.trials[k])
+                g.done[k] = True
+                # pad semantics: always-hit next launch, then idle
+                g.t_arr[k] = (0xFFFFFFFF, 0xFFFFFFFF)
+            else:
+                g.trials[k] += slab_trials
+                executed["trials"] += slab_trials
+
+    driver = _PipelineDriver(depth=depth, should_stop=should_stop)
+    try:
+        driver.run(next_launch, harvest,
+                   done=lambda: all(r is not None for r in results))
+    except PowInterrupted:
+        if any(r is None for r in results):
+            raise
+    if stats is not None:
+        stats.update(
+            mode=plan.mode, pack=pack, width=width, chunks=plan.chunks,
+            launches=executed["launches"],
+            executed_trials=executed["trials"],
+            credited_trials=sum(r[1] for r in results),
+            wall_seconds=driver.wall_seconds,
+            device_busy_ratio=driver.busy_ratio)
+    return results
+
+
+def _solve_single_sync(item, *, rows: int, unroll: int, chunks: int,
+                       impl: str, interpret: bool,
+                       autotuner: SlabAutotuner,
+                       should_stop: Callable[[], bool] | None):
+    """Latency-optimal degenerate path: one object, small synchronous
+    launches, no speculative dispatch-ahead (an extra in-flight slab
+    would only delay the answer for work expected to finish in the
+    first launch)."""
+    import numpy as np
+
+    initial_hash, target = item
+    target &= _MASK64
+    words = [int.from_bytes(initial_hash[i:i + 8], "big")
+             for i in range(0, 64, 8)]
+    ih_words = jnp.array([[[w >> 32, w & 0xFFFFFFFF] for w in words]],
+                         dtype=U32)
+    step_trials = rows * LANE_COLS * unroll
+    slab_trials = step_trials * chunks
+
+    base = 0
+    trials = 0
+    while True:
+        if should_stop is not None and should_stop():
+            raise PowInterrupted("pipelined PoW interrupted")
+        b_arr = jnp.array([[(base >> 32) & 0xFFFFFFFF,
+                            base & 0xFFFFFFFF]], dtype=U32)
+        # fresh per-iteration (not hoisted): the packed kernel donates
+        # its base/target buffers
+        t_arr = jnp.array([[target >> 32, target & 0xFFFFFFFF]],
+                          dtype=U32)
+        t0 = time.monotonic()
+        if impl == "pallas":
+            out = pallas_packed_search(ih_words, b_arr, t_arr, rows=rows,
+                                       chunks=chunks, pack=1,
+                                       unroll=unroll, interpret=interpret)
+        else:
+            out = _packed_search_xla(ih_words, b_arr, t_arr,
+                                     lanes=step_trials, chunks=chunks)
+        out = np.asarray(out)
+        autotuner.record("packed", chunks, time.monotonic() - t0)
+        step1 = int(out[0, 0])
+        if step1:
+            trials += step1 * step_trials
+            nonce = (int(out[0, 1]) << 32) | int(out[0, 2])
+            check = double_sha512(nonce.to_bytes(8, "big") + initial_hash)
+            if int.from_bytes(check[:8], "big") > target:
+                raise ArithmeticError(
+                    "accelerator returned an invalid PoW nonce")
+            return nonce, trials
+        trials += slab_trials
+        base = (base + slab_trials) & _MASK64
+
+
+def pipeline_snapshot() -> dict:
+    """Pipeline gauges for clientStatus / bench (one JSON-able dict)."""
+    return {
+        "deviceBusyRatio": round(
+            REGISTRY.sample("pow_pipeline_device_busy_ratio"), 4),
+        "depth": REGISTRY.sample("pow_pipeline_depth"),
+        "packOccupancy": round(
+            REGISTRY.sample("pow_pack_occupancy_ratio"), 4),
+    }

@@ -39,20 +39,36 @@ BATCHES = REGISTRY.counter(
 SOLVED = REGISTRY.counter(
     "pow_solved_total", "Solve requests completed through the service")
 
+#: default coalescing window in seconds; overridable per node via the
+#: ``powbatchwindow`` setting (core/config.py)
+DEFAULT_WINDOW = 0.05
+
 
 class PowService:
     """Owns a background task that drains solve requests in batches."""
 
     def __init__(self, dispatcher, *, shutdown: asyncio.Event | None = None,
-                 window: float = 0.05):
+                 window: float | None = None):
         self.dispatcher = dispatcher
         self.shutdown = shutdown or asyncio.Event()
-        self.window = window
+        self.window = DEFAULT_WINDOW if window is None else window
         self.queue: asyncio.Queue = asyncio.Queue()
         self._task: asyncio.Task | None = None
-        #: stats for clientStatus / observability
-        self.batches = 0
-        self.solved = 0
+        # batch/solve bookkeeping lives ONLY in the registry counters;
+        # per-instance views subtract the construction-time baseline so
+        # a fresh service still reports its own counts
+        self._batches_base = BATCHES.value
+        self._solved_base = SOLVED.value
+
+    @property
+    def batches(self) -> int:
+        """Coalesced launches through THIS service instance."""
+        return int(BATCHES.value - self._batches_base)
+
+    @property
+    def solved(self) -> int:
+        """Requests completed through THIS service instance."""
+        return int(SOLVED.value - self._solved_base)
 
     def start(self) -> asyncio.Task:
         self._task = asyncio.create_task(self._run())
@@ -102,8 +118,6 @@ class PowService:
                     if not fut.done():
                         fut.set_exception(exc)
                 continue
-            self.batches += 1
-            self.solved += len(batch)
             BATCHES.inc()
             SOLVED.inc(len(batch))
             if len(batch) > 1:

@@ -35,6 +35,10 @@ import inspect  # noqa: E402
 
 def pytest_configure(config):
     config.addinivalue_line("markers", "asyncio: run test via asyncio.run")
+    config.addinivalue_line(
+        "markers",
+        "slow: excluded from the tier-1 gate (-m 'not slow'); run "
+        "explicitly or in the full CI matrix")
 
 
 def pytest_pyfunc_call(pyfuncitem):

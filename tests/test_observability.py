@@ -317,6 +317,7 @@ def test_metric_naming_conventions():
     for mod in (
             "pybitmessage_tpu.pow.dispatcher",
             "pybitmessage_tpu.pow.service",
+            "pybitmessage_tpu.pow.pipeline",
             "pybitmessage_tpu.pow.verify_service",
             "pybitmessage_tpu.network.ratelimit",
             "pybitmessage_tpu.network.connection",

@@ -1,0 +1,290 @@
+"""Async double-buffered PoW pipeline (ISSUE 2): packing, planning,
+dispatch-ahead, autotuning, and the exported pipeline metrics.
+
+Runs on the CPU mesh: the packed Mosaic kernel is exercised through its
+XLA stand-in (``impl="xla"``), which shares the planner, the
+dispatch-ahead driver, the winner contract and the metrics with the
+device path — the same CI pattern as the sharded Pallas tier.
+"""
+
+import asyncio
+import hashlib
+
+import pytest
+
+from pybitmessage_tpu.ops.pow_search import PowInterrupted
+from pybitmessage_tpu.pow.pipeline import (
+    AUTOTUNER, BatchPlan, SlabAutotuner, expected_trials, plan_batch,
+    pipeline_snapshot, solve_batch_pipelined)
+
+
+def _host_trial(nonce: int, initial_hash: bytes) -> int:
+    d = hashlib.sha512(hashlib.sha512(
+        nonce.to_bytes(8, "big") + initial_hash).digest()).digest()
+    return int.from_bytes(d[:8], "big")
+
+
+def _items(n, target, tag=b"pipe"):
+    return [(hashlib.sha512(tag + b" %d" % i).digest(), target)
+            for i in range(n)]
+
+
+# ---------------------------------------------------------------------------
+# slab-size invariance (satellite): the winning nonce must not depend
+# on slab geometry, including autotuned shapes
+# ---------------------------------------------------------------------------
+
+
+def test_pow_search_jit_slab_shape_invariance():
+    from pybitmessage_tpu.ops.pow_search import pow_search_jit
+    from pybitmessage_tpu.ops.sha512_jax import initial_hash_words
+    from pybitmessage_tpu.ops.u64 import u64_from_int
+
+    ih = hashlib.sha512(b"slab invariance").digest()
+    target = 2 ** 57                       # mean ~128 trials
+    ih_hi, ih_lo = initial_hash_words(ih)
+    t_hi, t_lo = u64_from_int(target)
+    tuner = SlabAutotuner(target_seconds=0.25)
+    tuner.record("xla", 8, 0.2)            # pretend 25 ms/chunk
+    shapes = [(256, 8), (512, 4),
+              (256, tuner.suggest("xla", 8))]   # tuned -> (256, 8)
+    winners = set()
+    for start in (0, 5000):
+        nonces = []
+        for lanes, chunks in shapes:
+            s_hi, s_lo = u64_from_int(start)
+            found, n_hi, n_lo, _ = pow_search_jit(
+                ih_hi, ih_lo, t_hi, t_lo, s_hi, s_lo, lanes, chunks)
+            assert bool(found), (lanes, chunks)
+            nonces.append((int(n_hi) << 32) | int(n_lo))
+        assert len(set(nonces)) == 1, (
+            "winning nonce varies with slab shape: %r" % nonces)
+        winners.add(nonces[0])
+        assert _host_trial(nonces[0], ih) <= target
+    assert len(winners) == 2               # different starts, both real
+
+
+@pytest.mark.slow
+def test_solve_batch_pipelined_shape_invariance():
+    """The pipelined solver must return the same nonces regardless of
+    pack factor / chunk count (forced via explicit plans).  Slow-marked
+    (two jit shape compiles); the tier-1 gate keeps the satellite
+    pow_search_jit invariance test above."""
+    items = _items(5, 2 ** 56, tag=b"invariant")
+    # per-object lane shares 1024 and 512 at the same chunk count —
+    # shapes shared with the other tests so jit compiles amortize
+    plans = [BatchPlan("packed", 2, 4, list(range(5))),
+             BatchPlan("packed", 4, 4, list(range(5)))]
+    all_nonces = []
+    for plan in plans:
+        results = solve_batch_pipelined(items, rows=16, impl="xla",
+                                        plan=plan)
+        all_nonces.append([n for n, _ in results])
+        for (ih, target), (nonce, trials) in zip(items, results):
+            assert _host_trial(nonce, ih) <= target
+            assert trials > 0
+    assert all_nonces[0] == all_nonces[1]
+
+
+# ---------------------------------------------------------------------------
+# planner
+# ---------------------------------------------------------------------------
+
+
+def test_plan_packs_storm_and_keeps_hard_batches_whole():
+    storm = _items(64, 2 ** 60)            # tiny: mean 16 trials
+    plan = plan_batch(storm, rows=128)
+    assert plan.mode == "packed"
+    assert plan.pack == 16                 # max pack for tiny objects
+
+    hard = _items(8, 2 ** 38)              # mean ~67M trials/object
+    plan = plan_batch(hard, rows=128)
+    assert plan.mode == "batched"
+    assert plan.pack == 1
+
+
+def test_plan_degenerate_single_tiny_object_is_sync():
+    plan = plan_batch(_items(1, 2 ** 60), rows=128)
+    assert plan.mode == "single-sync"
+
+
+def test_plan_sorts_by_difficulty():
+    items = [(hashlib.sha512(b"a").digest(), 2 ** 50),
+             (hashlib.sha512(b"b").digest(), 2 ** 62),
+             (hashlib.sha512(b"c").digest(), 2 ** 56)]
+    plan = plan_batch(items, rows=128)
+    exp = [expected_trials(t) for _, t in items]
+    assert [exp[i] for i in plan.order] == sorted(exp)
+
+
+# ---------------------------------------------------------------------------
+# pipelined solving (XLA impl, CPU)
+# ---------------------------------------------------------------------------
+
+
+def test_pipelined_storm_solves_all_objects():
+    items = _items(23, 2 ** 57, tag=b"storm")   # pads to uneven groups
+    results = solve_batch_pipelined(
+        items, rows=32, impl="xla",
+        plan=BatchPlan("packed", 8, 4, list(range(23))))
+    assert len(results) == 23
+    for (ih, target), (nonce, trials) in zip(items, results):
+        assert _host_trial(nonce, ih) <= target
+        assert trials > 0
+
+
+def test_pipelined_degenerate_single_falls_back_to_sync_path():
+    """Acceptance: one tiny object must take the latency-optimal path
+    (mode counter 'single-sync' increments; result still verifies)."""
+    from pybitmessage_tpu.observability import REGISTRY
+
+    before = REGISTRY.sample("pow_pipeline_mode_total",
+                             {"mode": "single-sync"})
+    items = _items(1, 2 ** 57, tag=b"degenerate")
+    # plan_batch's choice for this input is asserted separately
+    # (test_plan_degenerate_single_tiny_object_is_sync); pinning the
+    # chunk count here keeps the jit shape ladder short
+    assert plan_batch(items, rows=16).mode == "single-sync"
+    [(nonce, trials)] = solve_batch_pipelined(
+        items, rows=16, impl="xla",
+        plan=BatchPlan("single-sync", 1, 4, [0]))
+    assert _host_trial(nonce, items[0][0]) <= items[0][1]
+    assert trials > 0
+    after = REGISTRY.sample("pow_pipeline_mode_total",
+                            {"mode": "single-sync"})
+    assert after == before + 1
+
+
+def test_pipelined_interrupt_raises():
+    items = _items(8, 2 ** 30, tag=b"hardwall")  # unreachably hard
+    calls = {"n": 0}
+
+    def stop():
+        calls["n"] += 1
+        return calls["n"] > 3
+
+    with pytest.raises(PowInterrupted):
+        solve_batch_pipelined(
+            items, rows=16, impl="xla",
+            plan=BatchPlan("packed", 4, 4, list(range(8))),
+            should_stop=stop)
+
+
+def test_pipeline_metrics_exported():
+    """Device-busy fraction, dispatch-ahead depth and pack occupancy
+    must land in the registry and the Prometheus exposition."""
+    from pybitmessage_tpu.observability import REGISTRY, render_prometheus
+
+    items = _items(8, 2 ** 57, tag=b"metrics")
+    solve_batch_pipelined(items, rows=16, impl="xla",
+                          plan=BatchPlan("packed", 4, 4,
+                                         list(range(8))))
+    text = render_prometheus()
+    for name in ("pow_pipeline_device_busy_ratio",
+                 "pow_pipeline_depth",
+                 "pow_pipeline_dispatch_ahead_size",
+                 "pow_pack_size",
+                 "pow_pack_occupancy_ratio",
+                 "pow_pipeline_mode_total",
+                 "pow_slab_seconds"):
+        assert name in text, name
+    assert REGISTRY.sample("pow_pipeline_device_busy_ratio") >= 0.0
+    # pack occupancy of the last launch is a real fraction
+    occ = REGISTRY.sample("pow_pack_occupancy_ratio")
+    assert 0.0 < occ <= 1.0
+    snap = pipeline_snapshot()
+    assert set(snap) == {"deviceBusyRatio", "depth", "packOccupancy"}
+
+
+# ---------------------------------------------------------------------------
+# autotuner
+# ---------------------------------------------------------------------------
+
+
+def test_autotuner_targets_poll_interval():
+    t = SlabAutotuner(target_seconds=0.5, min_chunks=4, max_chunks=2048)
+    assert t.suggest("k", 64) == 64        # no data -> default
+    t.record("k", 64, 6.4)                 # 100 ms/chunk
+    assert t.suggest("k", 64) == 4         # 0.5s/0.1 = 5 -> pow2 4
+    t2 = SlabAutotuner(target_seconds=0.5)
+    t2.record("k", 64, 0.0064)             # 0.1 ms/chunk
+    assert t2.suggest("k", 64) == 2048     # clamped at max
+    # EWMA: one outlier decays instead of sticking
+    t3 = SlabAutotuner(target_seconds=0.5, alpha=0.4)
+    for _ in range(20):
+        t3.record("k", 64, 0.64)           # steady 10 ms/chunk
+    t3.record("k", 64, 64.0)               # one relay stall
+    for _ in range(20):
+        t3.record("k", 64, 0.64)
+    assert t3.suggest("k", 64) in (32, 64)
+
+
+def test_autotuner_thread_safety():
+    import threading
+
+    t = SlabAutotuner()
+
+    def hammer():
+        for i in range(500):
+            t.record("k", 8, 0.1)
+            t.suggest("k", 8)
+
+    ts = [threading.Thread(target=hammer) for _ in range(4)]
+    for th in ts:
+        th.start()
+    for th in ts:
+        th.join()
+    assert t.seconds_per_chunk("k") == pytest.approx(0.1 / 8)
+
+
+# ---------------------------------------------------------------------------
+# service integration: registry is the single source of truth
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.asyncio
+async def test_service_counters_read_from_registry():
+    from pybitmessage_tpu.observability import REGISTRY
+    from pybitmessage_tpu.pow.service import PowService
+
+    class FakeDispatcher:
+        last_backend = "fake"
+
+        def solve_batch(self, items, should_stop=None):
+            return [(1, 1)] * len(items)
+
+    svc = PowService(FakeDispatcher(), window=0.01)
+    svc.start()
+    try:
+        await asyncio.gather(*(svc.solve(b"\x00" * 64, 2 ** 60)
+                               for _ in range(3)))
+        assert svc.batches == 1
+        assert svc.solved == 3
+        # the same numbers must be visible registry-side
+        assert REGISTRY.sample("pow_batches_total") >= 1
+        assert REGISTRY.sample("pow_solved_total") >= 3
+    finally:
+        await svc.stop()
+
+
+def test_service_window_configurable():
+    # load core/config.py standalone: the core package __init__ pulls
+    # in optional deps (cryptography) absent from the CI image
+    import importlib.util
+    import pathlib
+
+    path = (pathlib.Path(__file__).resolve().parent.parent
+            / "pybitmessage_tpu" / "core" / "config.py")
+    spec = importlib.util.spec_from_file_location("_pybm_config", path)
+    cfg = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(cfg)
+    Settings, SettingsError = cfg.Settings, cfg.SettingsError
+
+    s = Settings()
+    assert s.getfloat("powbatchwindow") == 0.05
+    s.set("powbatchwindow", "0.2")
+    assert s.getfloat("powbatchwindow") == 0.2
+    with pytest.raises(SettingsError):
+        s.set("powbatchwindow", "-1")
+    with pytest.raises(SettingsError):
+        s.set("powbatchwindow", "not-a-float")
