@@ -2,7 +2,7 @@
 # build + tox targets).  The C++ solver is also auto-built at runtime by
 # pybitmessage_tpu/pow/native.py when missing or stale.
 
-.PHONY: all native test bench bench-smoke clean
+.PHONY: all native test bench bench-smoke chaos clean
 
 all: native
 
@@ -14,6 +14,15 @@ test: native
 
 bench: native
 	python bench.py
+
+# seeded chaos suite on the CPU mesh (docs/resilience.md): fault
+# injection at pow.device_launch / pow.readback / db.write / net.send
+# proving no-object-loss + checkpoint resume; stays in the tier-1
+# "not slow" budget
+chaos: native
+	JAX_PLATFORMS=cpu BMTPU_CHAOS_SEED=1234 python -m pytest \
+		tests/test_resilience.py tests/test_resilience_chaos.py \
+		-q -m 'not slow'
 
 # tiny CPU-only pipeline bench for CI: reduced slabs, reference
 # test-mode difficulty, XLA impl (see docs/pow_pipeline.md)

@@ -566,6 +566,44 @@ def _bench_sharded_tier(initial_hash: bytes) -> dict:
     return {"per_chip_hps_1dev_mesh": round(rate, 1)}
 
 
+def _bench_degraded_fallback(n: int = 4, target_exp: int = 56) -> dict:
+    """Degraded-mode section (ISSUE 3): inject persistent device-launch
+    faults, solve a small queue through the ladder, and report what a
+    node actually delivers while its fastest tier is dead — plus the
+    breaker state proving fallbacks stop paying the failure latency
+    after it opens."""
+    import hashlib as _hl
+
+    from pybitmessage_tpu.pow import PowDispatcher
+    from pybitmessage_tpu.pow.dispatcher import host_trial
+    from pybitmessage_tpu.resilience import CHAOS
+
+    d = PowDispatcher(use_tpu=True,
+                      tpu_kwargs={"lanes": 1 << 12, "chunks_per_call": 8})
+    items = [(_hl.sha512(b"degraded %d" % i).digest(), 2 ** target_exp)
+             for i in range(n)]
+    CHAOS.arm("pow.device_launch", probability=1.0)
+    try:
+        t0 = time.perf_counter()
+        results = d.solve_batch(items)
+        dt = max(time.perf_counter() - t0, 1e-9)
+    finally:
+        CHAOS.disarm()
+    assert all(host_trial(nonce, ih) <= t
+               for (ih, t), (nonce, _) in zip(items, results))
+    trials = sum(r[1] for r in results)
+    return {
+        "objects": n,
+        "faults": "pow.device_launch p=1.0 (persistent)",
+        "rescue_backend": d.last_backend,
+        "tpu_breaker": d.breakers["tpu"].snapshot()["state"],
+        "wall_s": round(dt, 2),
+        "objects_per_s": round(n / dt, 2),
+        "degraded_hps": round(trials / dt, 1),
+        "no_object_loss": True,
+    }
+
+
 def _smoke_main() -> int:
     """Tiny CPU-only bench for CI (``make bench-smoke``): reduced
     slabs, reference test-mode difficulty, XLA impl — exercises the
@@ -640,6 +678,11 @@ def _smoke_main() -> int:
             solve_batch_pipelined(storm_items[:1], impl="xla", rows=32)),
     }
     configs["pipeline_overlap"] = _pipeline_stats()
+    # degraded mode: dead device tier, ladder + breaker rescue
+    try:
+        configs["degraded_fallback"] = _bench_degraded_fallback()
+    except Exception as exc:
+        configs["degraded_fallback"] = {"error": repr(exc)[:200]}
     print(json.dumps({
         "metric": "double_sha512_trial_hashes_per_sec_per_chip",
         "value": round(device, 1),
@@ -696,6 +739,13 @@ def main():
         # fraction, dispatch-ahead depth, pack-occupancy percentiles
         # accumulated across the batched-queue and storm configs
         configs["pipeline_overlap"] = _pipeline_stats()
+    # degraded-mode section (ISSUE 3): throughput with the device tier
+    # chaos-killed — the rate a node still delivers mid-outage, and
+    # the breaker state proving failures stop being paid per solve
+    try:
+        configs["degraded_fallback"] = _bench_degraded_fallback()
+    except Exception as exc:
+        configs["degraded_fallback"] = {"error": repr(exc)[:200]}
     # measured MFU from a profiler trace (device-side kernel time);
     # the wall-clock u32_ops_per_sec stays alongside for continuity
     mfu_info = None

@@ -89,14 +89,22 @@ async def run(args) -> int:
     from .storage.knownnodes import Peer
 
     settings = load_settings(args)
+    # fault injection is opt-in per node (chaos setting / BMTPU_CHAOS
+    # env) — production nodes run with every site disarmed
+    if settings.get("chaos"):
+        from .resilience import CHAOS
+        CHAOS.configure(settings.get("chaos"),
+                        seed=settings.getint("chaosseed"))
     # explicit PoW slab overrides reach the solver ladder's XLA tier
     # (the Pallas tier has its own measured sweet spot)
     solver = None
     if settings.is_set("powlanes") or settings.is_set("powchunks"):
         from .pow import PowDispatcher
-        solver = PowDispatcher(tpu_kwargs={
-            "lanes": settings.getint("powlanes"),
-            "chunks_per_call": settings.getint("powchunks")})
+        solver = PowDispatcher(
+            tpu_kwargs={
+                "lanes": settings.getint("powlanes"),
+                "chunks_per_call": settings.getint("powchunks")},
+            stall_timeout=settings.getfloat("powstalltimeout"))
     node = Node(args.data_dir,
                 solver=solver,
                 port=settings.getint("port"),
@@ -115,6 +123,20 @@ async def run(args) -> int:
     node.ctx.upload_bucket.rate = settings.getint("maxuploadrate") * 1024
     node.pool.max_outbound = settings.getint("maxoutboundconnections")
     node.pool.max_total = settings.getint("maxtotalconnections")
+    # resilience knobs (docs/resilience.md)
+    node.pool.dial_timeout = settings.getfloat("connecttimeout")
+    node.pool.handshake_timeout = settings.getfloat("handshaketimeout")
+    node.pool.dial_breaker_threshold = settings.getint("breakerfailures")
+    node.pool.dial_breaker_cooldown = settings.getfloat("breakercooldown")
+    if hasattr(node.solver, "stall_timeout"):
+        node.solver.stall_timeout = settings.getfloat("powstalltimeout")
+    if node.pow_service is not None:
+        node.pow_service.max_attempts = settings.getint("powmaxretries")
+    if hasattr(node.solver, "breakers"):
+        cpp = node.solver.breakers.get("cpp")
+        if cpp is not None:
+            cpp.threshold = settings.getint("breakerfailures")
+            cpp.cooldown = settings.getfloat("breakercooldown")
     node.sender.max_acceptable_ntpb = settings.getint(
         "maxacceptablenoncetrialsperbyte")
     node.sender.max_acceptable_extra = settings.getint(

@@ -774,6 +774,36 @@ class CommandHandler:
         return {"perBackend": per_backend, "fallbacks": fallbacks,
                 "batch": batch_stats, "pipeline": pipeline_snapshot()}
 
+    def _resilience_stats(self) -> dict:
+        """Failure-path health for clientStatus: breaker states, stall
+        and retry counters, journal depth, armed chaos sites — the
+        same series ``GET /metrics`` exports (docs/resilience.md)."""
+        from ..observability import REGISTRY
+        from ..resilience import CHAOS, breaker_snapshot
+        requeues = {}
+        rq = REGISTRY.get("pow_requeue_total")
+        if rq is not None:
+            for values, child in rq.children():
+                requeues[values[0]] = int(child.value)
+        journal = getattr(self.node, "pow_journal", None)
+        return {
+            "breakers": breaker_snapshot(),
+            "stallEvents": int(REGISTRY.sample(
+                "pow_stall_total", {"site": "pow.slab"})),
+            "handshakeTimeouts": int(REGISTRY.sample(
+                "network_handshake_timeout_total")),
+            "powRequeues": requeues,
+            "journal": {
+                "pending": (journal.pending_count()
+                            if journal is not None else None),
+                "checkpoints": int(REGISTRY.sample(
+                    "pow_journal_checkpoints_total")),
+                "recovered": int(REGISTRY.sample(
+                    "pow_journal_recovered_total")),
+            },
+            "chaos": CHAOS.active(),
+        }
+
     def cmd_clientStatus(self):
         pool = self.node.pool
         established = len(pool.established())
@@ -827,6 +857,8 @@ class CommandHandler:
             # per-tier solve counts/latencies, fallback events, batch
             # coalescing stats from the metrics registry (ISSUE 1)
             "powStats": self._pow_stats(),
+            # failure-path health: breaker/stall/journal state (ISSUE 3)
+            "resilience": self._resilience_stats(),
             "powVerify": {
                 "host": getattr(self.node.pow_verifier, "host_checked", 0),
                 "device": getattr(self.node.pow_verifier,

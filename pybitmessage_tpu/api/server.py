@@ -22,23 +22,62 @@ import json
 import logging
 import xmlrpc.client
 
+from ..observability import REGISTRY
+from ..resilience import Deadline, inject
 from .commands import APIError, CommandHandler
 
 logger = logging.getLogger("pybitmessage_tpu.api")
 
 MAX_REQUEST = 32 * 1024 * 1024
+#: per-request wall budget; propagated as a resilience Deadline so
+#: nested retries stop scheduling attempts that cannot finish in time
+DEFAULT_REQUEST_TIMEOUT = 120.0
+
+API_REQUESTS = REGISTRY.counter(
+    "api_requests_total", "RPC dispatches by outcome", ("outcome",))
+API_REQUEST_SECONDS = REGISTRY.histogram(
+    "api_request_seconds", "RPC dispatch wall time")
 
 
 class APIServer:
     def __init__(self, node, *, host: str = "127.0.0.1", port: int = 8442,
-                 username: str = "", password: str = ""):
+                 username: str = "", password: str = "",
+                 request_timeout: float = DEFAULT_REQUEST_TIMEOUT):
         self.node = node
         self.host = host
         self.port = port
         self.username = username
         self.password = password
+        self.request_timeout = request_timeout
         self.handler = CommandHandler(node)
         self._server: asyncio.AbstractServer | None = None
+
+    async def _call(self, method: str, params: list):
+        """One command dispatch under the request deadline (also a
+        chaos injection site, ``api.dispatch``)."""
+        import time as _time
+        t0 = _time.monotonic()
+        try:
+            inject("api.dispatch")
+            with Deadline(self.request_timeout):
+                result = await asyncio.wait_for(
+                    self.handler.dispatch(method, params),
+                    timeout=self.request_timeout)
+            API_REQUESTS.labels(outcome="ok").inc()
+            return result
+        except APIError:
+            API_REQUESTS.labels(outcome="api_error").inc()
+            raise
+        except asyncio.TimeoutError:
+            API_REQUESTS.labels(outcome="timeout").inc()
+            raise APIError(
+                1, "request exceeded the %.0fs server deadline"
+                % self.request_timeout)
+        except Exception:
+            API_REQUESTS.labels(outcome="error").inc()
+            raise
+        finally:
+            API_REQUEST_SECONDS.observe(_time.monotonic() - t0)
 
     async def start(self) -> None:
         self._server = await asyncio.start_server(
@@ -144,7 +183,7 @@ class APIServer:
         params = req.get("params", [])
         rid = req.get("id")
         try:
-            result = await self.handler.dispatch(method, list(params))
+            result = await self._call(method, list(params))
             return {"jsonrpc": "2.0", "result": result, "id": rid}
         except APIError as exc:
             return {"jsonrpc": "2.0", "id": rid,
@@ -163,7 +202,7 @@ class APIServer:
                 xmlrpc.client.Fault(1, "malformed XML-RPC request"),
                 allow_none=True).encode()
         try:
-            result = await self.handler.dispatch(method, list(params))
+            result = await self._call(method, list(params))
             return xmlrpc.client.dumps((result,), methodresponse=True,
                                        allow_none=True).encode()
         except APIError as exc:

@@ -79,6 +79,19 @@ DEFAULTS: dict[str, str] = {
     "powchunks": "32",               # chunks per jitted call
     "powbatchwindow": "0.05",        # PoW coalescing window, seconds
                                      # (0 = launch immediately)
+    # -- resilience (docs/resilience.md) --
+    "powstalltimeout": "120",        # per-harvest slab stall deadline,
+                                     # seconds (0 = watchdog off)
+    "powmaxretries": "3",            # solve attempts before a queued
+                                     # object surfaces its error
+    "breakerfailures": "3",          # consecutive failures opening the
+                                     # native-tier/dial breakers
+    "breakercooldown": "60",         # seconds before a half-open probe
+    "connecttimeout": "10",          # outbound dial budget, seconds
+    "handshaketimeout": "30",        # version/verack must finish in this
+    "chaos": "",                     # fault-injection spec, e.g.
+                                     # "pow.device_launch:0.5,db.write:1x3"
+    "chaosseed": "0",                # deterministic chaos seed
     "blackwhitelist": "black",       # inbound sender policy
     # ceilings on recipient-demanded PoW; 0 = unlimited (reference
     # helper_startup sanity cap: ridiculousDifficulty x network default)
@@ -130,6 +143,13 @@ VALIDATORS: dict[str, Callable[[str], bool]] = {
     "powlanes": _validate_int_range(128, 1 << 24),
     "powchunks": _validate_int_range(1, 4096),
     "powbatchwindow": _validate_float_range(0.0, 10.0),
+    "powstalltimeout": _validate_float_range(0.0, 86400.0),
+    "powmaxretries": _validate_int_range(1, 100),
+    "breakerfailures": _validate_int_range(1, 1000),
+    "breakercooldown": _validate_float_range(0.0, 86400.0),
+    "connecttimeout": _validate_float_range(1.0, 300.0),
+    "handshaketimeout": _validate_float_range(1.0, 3600.0),
+    "chaosseed": _validate_int_range(0, 2**63 - 1),
     "apienabled": _validate_bool,
     "notifysound": _validate_bool,
     "smtpdenabled": _validate_bool,

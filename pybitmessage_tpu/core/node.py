@@ -91,13 +91,25 @@ class Node:
         self.ctx.pow_verifier = self.pow_verifier
         #: solver ladder: TPU -> C++ -> python (proofofwork.run analog)
         self.solver = solver or PowDispatcher()
+        #: crash-safe PoW job journal: queued/in-flight solves survive
+        #: restart and resume from their checkpointed nonce offset
+        #: (resilience/journal.py; in-memory when no data_dir)
+        from ..resilience import PowJournal
+        journal_path = (str(self.data_dir / "powjournal.dat")
+                        if self.data_dir else ":memory:")
+        self.pow_journal = PowJournal(journal_path)
+        pending = self.pow_journal.pending_count()
+        if pending:
+            logger.info("PoW journal: %d job(s) survived restart and "
+                        "will resume from their checkpoints", pending)
         #: batching front-end — only when the solver supports batches
         self.pow_service = None
         if hasattr(self.solver, "solve_batch"):
             from ..pow.service import PowService
             self.pow_service = PowService(self.solver,
                                           shutdown=self.shutdown,
-                                          window=pow_window)
+                                          window=pow_window,
+                                          journal=self.pow_journal)
 
         from .uisignal import UISignaler
         self.ui = UISignaler()
@@ -173,6 +185,7 @@ class Node:
         await self.pow_verifier.stop()
         self.inventory.flush()
         self.knownnodes.save()
+        self.pow_journal.close()
         self.db.close()
         logger.info("node stopped")
 

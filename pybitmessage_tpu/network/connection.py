@@ -28,6 +28,8 @@ from ..models.packet import (
 )
 from ..models.pow_math import check_pow
 from ..observability import REGISTRY
+from ..resilience import inject
+from ..resilience.policy import ERRORS
 from ..utils.hashes import inventory_hash
 from ..utils.varint import VarintError
 from .messages import (
@@ -60,6 +62,10 @@ PACKETS_TX = PACKETS.labels(direction="tx")
 PACKET_ERRORS = REGISTRY.counter(
     "network_packet_errors_total",
     "Frames dropped for bad checksum / oversize payload")
+HANDSHAKE_TIMEOUTS = REGISTRY.counter(
+    "network_handshake_timeout_total",
+    "Connections closed because version/verack never completed — "
+    "black-holed peers no longer pin a slot forever")
 
 
 class ConnectionClosed(Exception):
@@ -100,12 +106,33 @@ class BMConnection:
         self._verify_sem = asyncio.Semaphore(VERIFY_WINDOW)
         self._verify_tasks: set[asyncio.Task] = set()
         self._task: asyncio.Task | None = None
+        self._handshake_task: asyncio.Task | None = None
 
     # -- lifecycle -----------------------------------------------------------
 
     def start(self) -> asyncio.Task:
         self._task = asyncio.create_task(self._run())
         return self._task
+
+    def arm_handshake_timeout(self, timeout: float) -> None:
+        """Close the connection if version/verack has not completed
+        within ``timeout`` seconds (``asyncio.wait_for`` semantics via
+        a watchdog task so the read loop itself stays untouched) — a
+        black-holed peer must not hang the slot forever."""
+        if timeout and timeout > 0 and not self.fully_established:
+            self._handshake_task = asyncio.create_task(
+                self._handshake_watchdog(timeout))
+
+    async def _handshake_watchdog(self, timeout: float) -> None:
+        try:
+            await asyncio.sleep(timeout)
+        except asyncio.CancelledError:
+            return
+        if not self.fully_established and not self._closed:
+            HANDSHAKE_TIMEOUTS.inc()
+            logger.debug("connection %s:%s handshake timed out after "
+                         "%.0fs; closing", self.host, self.port, timeout)
+            await self.close()
 
     async def _run(self) -> None:
         try:
@@ -135,6 +162,10 @@ class BMConnection:
         # hash marked in flight).  They settle within one verifier
         # round; node shutdown resolves them by cancelling the
         # verifier's futures instead.
+        if self._handshake_task is not None and \
+                not self._handshake_task.done() and \
+                self._handshake_task is not asyncio.current_task():
+            self._handshake_task.cancel()
         if self._task is not None and not self._task.done() and \
                 self._task is not asyncio.current_task():
             self._task.cancel()
@@ -143,8 +174,13 @@ class BMConnection:
             # bounded: a mid-handshake TLS transport can wedge the
             # orderly-shutdown wait forever
             await asyncio.wait_for(self.writer.wait_closed(), 3.0)
-        except Exception:
-            pass
+        except Exception as exc:
+            # a transport that fails to close cleanly is routine for a
+            # dead peer — but never swallow it SILENTLY (lint-enforced,
+            # tests/test_observability.py)
+            ERRORS.labels(site="net.close").inc()
+            logger.debug("transport close for %s:%s failed: %r",
+                         self.host, self.port, exc)
         self.pool.connection_closed(self)
 
     # -- framing -------------------------------------------------------------
@@ -200,6 +236,7 @@ class BMConnection:
         await handler(payload)
 
     async def send_packet(self, command: str, payload: bytes = b"") -> None:
+        inject("net.send")
         frame = pack_packet(command, payload)
         await self.ctx.upload_bucket.consume(len(frame))
         PACKETS_TX.inc()
@@ -296,6 +333,9 @@ class BMConnection:
                 and self.ctx.services & NODE_SSL:
             await self._upgrade_tls()
         self.fully_established = True
+        if self._handshake_task is not None:
+            self._handshake_task.cancel()
+            self._handshake_task = None
         self._anti_intersection_delay(initial=True)
         await self._send_addr_sample()
         await self._send_big_inv()

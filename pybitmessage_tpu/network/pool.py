@@ -18,6 +18,8 @@ from collections import deque
 from typing import Callable, Optional
 
 from ..observability import REGISTRY
+from ..resilience import CircuitBreaker, inject
+from ..resilience.policy import ERRORS
 from ..storage.knownnodes import Peer
 from .connection import BMConnection
 from .messages import AddrEntry, is_private_host, network_group
@@ -35,6 +37,10 @@ DIALS = REGISTRY.counter(
 OBJECTS_RECEIVED = REGISTRY.counter(
     "network_objects_received_total",
     "Valid objects accepted from the network")
+ANNOUNCE_RETRIES = REGISTRY.counter(
+    "network_announce_requeue_total",
+    "Inv/addr announcements put back after a failed send — retried "
+    "next tick instead of silently lost")
 
 
 def _is_local_address(host: str) -> bool:
@@ -59,6 +65,13 @@ DEFAULT_MAX_TOTAL = 200
 PING_INTERVAL = 300
 INV_INTERVAL = 1.0
 DOWNLOAD_INTERVAL = 1.0
+#: TCP connect budget for one outbound dial (``connecttimeout``)
+DEFAULT_DIAL_TIMEOUT = 10.0
+#: version/verack must complete within this or the slot is reclaimed —
+#: a black-holed peer must not pin a connection slot forever
+DEFAULT_HANDSHAKE_TIMEOUT = 30.0
+#: per-peer dial breakers kept at most (oldest dropped beyond this)
+MAX_DIAL_BREAKERS = 512
 
 
 class NodeContext:
@@ -116,12 +129,26 @@ class ConnectionPool:
                  max_outbound: int = DEFAULT_MAX_OUTBOUND,
                  max_total: int = DEFAULT_MAX_TOTAL,
                  listen_host: str = "127.0.0.1",
-                 trusted_peer: Optional[Peer] = None):
+                 trusted_peer: Optional[Peer] = None,
+                 dial_timeout: float = DEFAULT_DIAL_TIMEOUT,
+                 handshake_timeout: float = DEFAULT_HANDSHAKE_TIMEOUT):
         self.ctx = ctx
         self.max_outbound = max_outbound
         self.max_total = max_total
         self.listen_host = listen_host
         self.trusted_peer = trusted_peer
+        self.dial_timeout = dial_timeout
+        self.handshake_timeout = handshake_timeout
+        #: per-peer dial breaker tuning (``breakerfailures`` /
+        #: ``breakercooldown``, applied by __main__) — takes effect for
+        #: breakers created after the change
+        self.dial_breaker_threshold = 3
+        self.dial_breaker_cooldown = 120.0
+        #: per-peer dial circuit breakers: a repeatedly unreachable
+        #: peer stops consuming dial-loop ticks until its cooldown.
+        #: Unregistered + one shared metric label — peer addresses
+        #: must not explode metric cardinality.
+        self._dial_breakers: dict[str, CircuitBreaker] = {}
         self.inbound: dict[BMConnection, None] = {}
         self.outbound: dict[BMConnection, None] = {}
         self._server: asyncio.AbstractServer | None = None
@@ -194,31 +221,61 @@ class ConnectionPool:
         self.inbound[conn] = None
         CONNECTIONS.labels(direction="inbound").set(len(self.inbound))
         conn.start()
+        # a peer that never completes version/verack must not pin an
+        # inbound slot forever (black-holed / port-scanning peers)
+        conn.arm_handshake_timeout(self.handshake_timeout)
+
+    def _dial_breaker(self, peer: Peer) -> CircuitBreaker:
+        key = "%s:%d" % (peer.host, peer.port)
+        br = self._dial_breakers.get(key)
+        if br is None:
+            while len(self._dial_breakers) >= MAX_DIAL_BREAKERS:
+                self._dial_breakers.pop(next(iter(self._dial_breakers)))
+            br = self._dial_breakers[key] = CircuitBreaker(
+                "net.dial:%s" % key,
+                threshold=self.dial_breaker_threshold,
+                cooldown=self.dial_breaker_cooldown,
+                label="net.dial", register=False)
+        return br
 
     async def connect_to(self, peer: Peer) -> BMConnection | None:
+        breaker = self._dial_breaker(peer)
+        if not breaker.allow():
+            # repeatedly-dead peer: don't pay the connect timeout again
+            # until the breaker's cooldown lets a probe through
+            DIALS.labels(result="skipped").inc()
+            return None
         try:
+            inject("net.dial")
             if self.ctx.proxy is not None:
                 from .socks import open_via_proxy
                 p = self.ctx.proxy
-                reader, writer = await open_via_proxy(
-                    p["type"], p["host"], p["port"], peer.host, peer.port,
-                    username=p.get("username", ""),
-                    password=p.get("password", ""), timeout=30)
+                reader, writer = await asyncio.wait_for(
+                    open_via_proxy(
+                        p["type"], p["host"], p["port"], peer.host,
+                        peer.port,
+                        username=p.get("username", ""),
+                        password=p.get("password", ""), timeout=30),
+                    timeout=max(self.dial_timeout, 30))
             else:
                 reader, writer = await asyncio.wait_for(
                     asyncio.open_connection(peer.host, peer.port),
-                    timeout=10)
+                    timeout=self.dial_timeout)
         except (OSError, asyncio.TimeoutError) as exc:
             logger.debug("dial %s failed: %r", peer, exc)
             DIALS.labels(result="failed").inc()
+            ERRORS.labels(site="net.dial").inc()
+            breaker.record_failure()
             self.ctx.knownnodes.decrease_rating(peer)
             return None
+        breaker.record_success()
         conn = BMConnection(self, reader, writer, outbound=True,
                             host=peer.host, port=peer.port)
         self.outbound[conn] = None
         DIALS.labels(result="connected").inc()
         CONNECTIONS.labels(direction="outbound").set(len(self.outbound))
         conn.start()
+        conn.arm_handshake_timeout(self.handshake_timeout)
         return conn
 
     def connection_established(self, conn: BMConnection) -> None:
@@ -293,6 +350,7 @@ class ConnectionPool:
             except asyncio.CancelledError:
                 raise
             except Exception:
+                ERRORS.labels(site="net.dial_loop").inc()
                 logger.exception("dial loop error")
             await asyncio.sleep(2)
 
@@ -336,6 +394,7 @@ class ConnectionPool:
             except asyncio.CancelledError:
                 raise
             except Exception:
+                ERRORS.labels(site="net.inv_loop").inc()
                 logger.exception("inv loop error")
 
     async def _flush_addr_gossip(self) -> None:
@@ -399,10 +458,25 @@ class ConnectionPool:
                     stems.append(h)
                 # else: in stem phase routed to another child — skip
             random.shuffle(fluffs)
-            if fluffs:
-                await conn.announce(fluffs)
-            if stems:
-                await conn.announce(stems, stem=True)
+            sends = [(hs, stem) for hs, stem in
+                     ((fluffs, False), (stems, True)) if hs]
+            for i, (hashes, stem) in enumerate(sends):
+                try:
+                    await conn.announce(hashes, stem=stem)
+                except (ConnectionError, OSError) as exc:
+                    # a failed send must not LOSE the announcements —
+                    # requeue ONLY the unsent groups (re-inv'ing the
+                    # delivered portion would duplicate traffic) so
+                    # the next tick re-delivers; a gone peer's tracker
+                    # is discarded by connection_closed anyway
+                    unsent = [h for hs, _ in sends[i:] for h in hs]
+                    ERRORS.labels(site="net.send").inc()
+                    ANNOUNCE_RETRIES.inc(len(unsent))
+                    logger.debug("announce to %s failed (%r); requeued "
+                                 "%d hashes", conn.host, exc, len(unsent))
+                    for h in unsent:
+                        conn.tracker.we_should_announce(h)
+                    break
 
     async def _download_loop(self) -> None:
         while True:
@@ -416,6 +490,7 @@ class ConnectionPool:
             except asyncio.CancelledError:
                 raise
             except Exception:
+                ERRORS.labels(site="net.download_loop").inc()
                 logger.exception("download loop error")
 
     async def _maintenance_loop(self) -> None:
@@ -436,4 +511,5 @@ class ConnectionPool:
             except asyncio.CancelledError:
                 raise
             except Exception:
+                ERRORS.labels(site="net.maintenance_loop").inc()
                 logger.exception("maintenance loop error")

@@ -50,16 +50,19 @@ class PowInterrupted(Exception):
 def _run_host_driver(search_once, initial_hash: bytes, target: int, *,
                      start_nonce: int, trials_per_call_step: int,
                      should_stop: Callable[[], bool] | None,
-                     on_slab: Callable[[float], None] | None = None):
+                     on_slab: Callable[[float], None] | None = None,
+                     progress: Callable[[int], None] | None = None):
     """Shared host loop over a jitted search slab.
 
     ``search_once(b_hi, b_lo) -> (found, n_hi, n_lo, chunks)``;
     ``trials_per_call_step`` = trials represented by one chunk across
     all participating devices.  ``on_slab`` (if given) receives each
     slab's measured wall seconds — the autotuner's latency feedback.
-    Re-verifies the winning nonce with hashlib before returning,
-    guarding against accelerator miscompute (the reference re-checks
-    OpenCL results, proofofwork.py:302-313).
+    ``progress`` (if given) receives the next base after every
+    miss-free slab — the resumable-PoW checkpoint hook.  Re-verifies
+    the winning nonce with hashlib before returning, guarding against
+    accelerator miscompute (the reference re-checks OpenCL results,
+    proofofwork.py:302-313).
     """
     import time as _time
 
@@ -83,6 +86,8 @@ def _run_host_driver(search_once, initial_hash: bytes, target: int, *,
                     "accelerator returned an invalid PoW nonce")
             return nonce, trials
         base += chunks * trials_per_call_step
+        if progress is not None:
+            progress(base)
 
 
 @functools.partial(jax.jit,
@@ -133,7 +138,8 @@ def solve(initial_hash: bytes, target: int, *,
           chunks_per_call: int = DEFAULT_CHUNKS_PER_CALL,
           variant: str = DEFAULT_VARIANT,
           should_stop: Callable[[], bool] | None = None,
-          tuner=None, tuner_kind: str = "xla"):
+          tuner=None, tuner_kind: str = "xla",
+          progress: Callable[[int], None] | None = None):
     """Find a nonce whose trial value is <= target.
 
     Host driver over :func:`pow_search_jit`; between jitted slabs the
@@ -168,7 +174,7 @@ def solve(initial_hash: bytes, target: int, *,
     return _run_host_driver(
         search_once, initial_hash, target, start_nonce=start_nonce,
         trials_per_call_step=lanes, should_stop=should_stop,
-        on_slab=on_slab)
+        on_slab=on_slab, progress=progress)
 
 
 @jax.jit

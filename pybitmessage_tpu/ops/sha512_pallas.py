@@ -680,7 +680,7 @@ def solve(initial_hash: bytes, target: int, *,
           chunks_per_call: int = DEFAULT_CHUNKS,
           unroll: int = DEFAULT_UNROLL, should_stop=None,
           interpret: bool = False, tuner=None,
-          tuner_kind: str = "pallas_single"):
+          tuner_kind: str = "pallas_single", progress=None):
     """Find a nonce whose trial value is <= target (Pallas backend).
 
     Same contract as :func:`pow_search.solve`: returns
@@ -747,7 +747,7 @@ def solve(initial_hash: bytes, target: int, *,
 
     base = start_nonce & mask64
     trials = 0
-    pending = None  # ((found_dev, nonce_dev), dispatch_time)
+    pending = None  # ((found_dev, nonce_dev), dispatch_time, end_base)
     while True:
         if should_stop is not None and should_stop():
             # the in-flight slab may already hold the answer — check
@@ -757,9 +757,12 @@ def solve(initial_hash: bytes, target: int, *,
                 nonce = harvest(*pending[0])
                 if nonce is not None:
                     return nonce, trials
+                if progress is not None:
+                    progress(pending[2])
             raise PowInterrupted("Pallas PoW interrupted by shutdown")
-        current = (launch(base), _time.monotonic())
-        base = (base + trials_per_slab) & mask64
+        end_base = (base + trials_per_slab) & mask64
+        current = (launch(base), _time.monotonic(), end_base)
+        base = end_base
         if pending is not None:
             trials += trials_per_slab
             nonce = harvest(*pending[0])
@@ -770,4 +773,8 @@ def solve(initial_hash: bytes, target: int, *,
                              _time.monotonic() - pending[1])
             if nonce is not None:
                 return nonce, trials
+            if progress is not None:
+                # the pending slab harvested miss-free: its end is the
+                # resumable-PoW checkpoint (resilience/journal.py)
+                progress(pending[2])
         pending = current

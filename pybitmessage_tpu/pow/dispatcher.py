@@ -4,6 +4,16 @@ Reference semantics (proofofwork.py:288-325): try the fastest backend;
 on failure log and fall through to the next; every tier is
 interruptible; the winning nonce is host-verified before being trusted
 (the TPU tier already re-checks internally, ops/pow_search.py).
+
+Tier health is managed by per-tier circuit breakers
+(resilience/policy.py) instead of the old permanent latch: a failing
+tier opens after ``threshold`` consecutive failures (1 for the device
+tiers — a failed Mosaic compile costs ~75 s and must not be re-paid
+per solve), fallbacks stop paying the failure latency while it is
+open, and a half-open probe after the cooldown lets a recovered
+device rejoin the ladder.  ``pow.device_launch`` is a chaos injection
+site (docs/resilience.md); slab-level stall detection lives in
+pipeline.py and surfaces here as an ordinary tier failure.
 """
 
 from __future__ import annotations
@@ -15,9 +25,16 @@ from typing import Callable
 
 from ..observability import REGISTRY, trace
 from ..ops.pow_search import PowInterrupted
+from ..resilience import CircuitBreaker, inject
+from ..resilience.policy import ERRORS
+from ..resilience.watchdog import STALL_RECOVERY_SECONDS
 from .native import NativeSolver
 
 logger = logging.getLogger("pybitmessage_tpu.pow")
+
+#: slab-stall deadline handed to the pipeline (seconds per harvest,
+#: generous enough for a cold Mosaic compile); 0 disables the watchdog
+DEFAULT_STALL_TIMEOUT = 120.0
 
 SOLVE_SECONDS = REGISTRY.histogram(
     "pow_solve_seconds",
@@ -56,14 +73,22 @@ def host_trial(nonce: int, initial_hash: bytes) -> int:
 
 def python_solve(initial_hash: bytes, target: int, *,
                  start_nonce: int = 0,
-                 should_stop: Callable[[], bool] | None = None):
-    """The always-works tier (reference _doSafePoW, proofofwork.py:157-171)."""
+                 should_stop: Callable[[], bool] | None = None,
+                 progress: Callable[[int], None] | None = None):
+    """The always-works tier (reference _doSafePoW, proofofwork.py:157-171).
+
+    ``progress(next_nonce)``, when given, checkpoints resumable search
+    state at the same 4096-trial cadence as the stop poll: every nonce
+    below the reported value has been searched without a hit.
+    """
     nonce = start_nonce
     trials = 0
     sha512 = hashlib.sha512
     while True:
         if should_stop is not None and trials % 4096 == 0 and should_stop():
             raise PowInterrupted("python PoW interrupted")
+        if progress is not None and trials % 4096 == 0 and trials:
+            progress(nonce)
         value = int.from_bytes(sha512(sha512(
             nonce.to_bytes(8, "big") + initial_hash).digest()
         ).digest()[:8], "big")
@@ -97,10 +122,11 @@ class PowDispatcher:
     """
 
     def __init__(self, *, use_tpu: bool = True, use_native: bool = True,
-                 tpu_kwargs: dict | None = None, num_threads: int = 0):
+                 tpu_kwargs: dict | None = None, num_threads: int = 0,
+                 stall_timeout: float = DEFAULT_STALL_TIMEOUT,
+                 breakers: dict[str, CircuitBreaker] | None = None):
         self.tpu_kwargs = tpu_kwargs or {}
         self._tpu_enabled = use_tpu
-        self._pallas_enabled = use_tpu
         self._native = NativeSolver(num_threads) if use_native else None
         self.last_backend = ""
         self.last_rate = 0.0
@@ -108,6 +134,23 @@ class PowDispatcher:
         self.last_solve_rate = 0.0
         self.last_verify_seconds = 0.0
         self._meshes: dict = {}
+        #: per-harvest slab stall deadline for the pipelined path
+        self.stall_timeout = stall_timeout
+        #: per-tier circuit breakers (threshold 1 on the device tiers:
+        #: one failure is a dead/miscompiling device and re-probing it
+        #: costs a full compile — the half-open probe after cooldown
+        #: replaces the old permanent latch)
+        self.breakers = breakers or {
+            "tpu": CircuitBreaker("pow.tier.tpu", threshold=1,
+                                  cooldown=300.0),
+            "tpu-pallas": CircuitBreaker("pow.tier.tpu-pallas",
+                                         threshold=1, cooldown=600.0),
+            "cpp": CircuitBreaker("pow.tier.cpp", threshold=3,
+                                  cooldown=60.0),
+        }
+        #: monotonic time of the last slab stall — recovery latency is
+        #: observed when a fallback tier completes the rescued work
+        self._stalled_at: float | None = None
 
     # -- device topology -----------------------------------------------------
 
@@ -115,8 +158,23 @@ class PowDispatcher:
         try:
             import jax
             return len(jax.devices())
-        except Exception:
+        except Exception as exc:
+            ERRORS.labels(site="pow.device_probe").inc()
+            logger.debug("device probe failed: %r", exc)
             return 0
+
+    def _record_recovery(self) -> None:
+        """A solve completed after a slab stall: export how long the
+        rescued work took to land on a fallback tier."""
+        if self._stalled_at is not None:
+            STALL_RECOVERY_SECONDS.observe(
+                time.monotonic() - self._stalled_at)
+            self._stalled_at = None
+
+    def _note_stall(self, exc: Exception) -> None:
+        from ..resilience.watchdog import SlabStallError
+        if isinstance(exc, SlabStallError) and self._stalled_at is None:
+            self._stalled_at = time.monotonic()
 
     def _mesh(self, ndev: int, batch: int):
         """(obj x nonce) mesh for ``batch`` objects; 1D when batch == 1."""
@@ -138,10 +196,13 @@ class PowDispatcher:
         return self._meshes[key]
 
     def backends(self) -> list[str]:
+        """Currently-usable tiers: statically enabled AND not sitting
+        behind an open (pre-cooldown) circuit breaker."""
         out = []
-        if self._tpu_enabled:
+        if self._tpu_enabled and self.breakers["tpu"].available():
             out.append("tpu")
-        if self._native is not None and self._native.available:
+        if self._native is not None and self._native.available and \
+                self.breakers["cpp"].available():
             out.append("cpp")
         out.append("python")
         return out
@@ -166,6 +227,7 @@ class PowDispatcher:
                     self.last_backend)
             span.attrs["backend"] = self.last_backend
             span.attrs["trials"] = trials
+        self._record_recovery()
         self.last_solve_seconds = solve_dt
         self.last_solve_rate = trials / solve_dt
         self.last_verify_seconds = verify_dt
@@ -178,43 +240,57 @@ class PowDispatcher:
     # keep the explicit name too
     solve = __call__
 
-    def solve_batch(self, items, *, should_stop=None):
+    def solve_batch(self, items, *, should_stop=None, start_nonces=None,
+                    progress=None):
         """Solve ``[(initial_hash, target), ...]`` -> ``[(nonce, trials)]``.
 
         All pending objects go down in ONE pod-wide launch when a
         multi-device mesh is available (objects data-parallel x nonce
         range partitioned); otherwise objects are solved sequentially
         through the normal ladder.
+
+        Resumable-PoW hooks: ``start_nonces`` (one offset per item)
+        resumes each object's search from a journaled checkpoint, and
+        ``progress(i, next_nonce)`` is called as slabs harvest with
+        the highest offset known fully searched for item ``i`` — the
+        pipelined single-chip path and the sequential ladder honor
+        both; the pod-sharded batch kernels re-search from 0 (their
+        range partition is device-resident) but remain correct.
         """
         items = list(items)
         if not items:
             return []
+        starts = list(start_nonces) if start_nonces else [0] * len(items)
         t0 = time.monotonic()
         results = None
+        pb = self.breakers["tpu-pallas"]
+        tb = self.breakers["tpu"]
         with trace("pow.solve_batch", objects=len(items)) as span:
             if self._tpu_enabled and len(items) > 1:
                 ndev = self._device_count()
                 if ndev > 1:
-                    if self._pallas_enabled and self._on_accelerator():
+                    if self._on_accelerator() and pb.allow():
                         try:
+                            inject("pow.device_launch")
                             from ..parallel import pallas_sharded_solve_batch
                             self.last_backend = "tpu-pallas-sharded-batch"
                             ATTEMPTS.labels(backend=self.last_backend).inc()
                             results = pallas_sharded_solve_batch(
                                 items, self._mesh(ndev, len(items)),
                                 should_stop=should_stop)
+                            pb.record_success()
+                            tb.record_success()
                         except PowInterrupted:
+                            pb.release_probe()
                             raise
-                        except Exception:
+                        except Exception as exc:
                             logger.exception(
                                 "sharded batched Pallas PoW failed; using "
                                 "sharded XLA batch")
-                            self._pallas_enabled = False
-                            FALLBACKS.labels(
-                                **{"from": "tpu-pallas",
-                                   "to": "tpu-xla"}).inc()
-                    if results is None:
+                            self._pallas_failed(exc, "tpu-xla")
+                    if results is None and tb.allow():
                         try:
+                            inject("pow.device_launch")
                             from ..parallel import sharded_solve_batch
                             self.last_backend = "tpu-batch"
                             ATTEMPTS.labels(backend=self.last_backend).inc()
@@ -222,16 +298,21 @@ class PowDispatcher:
                                 items, self._mesh(ndev, len(items)),
                                 should_stop=should_stop,
                                 **self._xla_kwargs())
+                            tb.record_success()
                         except PowInterrupted:
+                            tb.release_probe()
                             raise
-                        except Exception:
+                        except Exception as exc:
+                            self._note_stall(exc)
+                            tb.record_failure()
+                            ERRORS.labels(site="pow.tier.tpu").inc()
                             logger.exception(
                                 "batched TPU PoW failed; falling back to "
                                 "per-object solves")
                             FALLBACKS.labels(
                                 **{"from": "tpu-batch",
                                    "to": "ladder"}).inc()
-                elif self._pallas_enabled and self._on_accelerator():
+                elif self._on_accelerator() and pb.allow():
                     # single chip: the async double-buffered pipeline
                     # plans the launch shape (multi-object slab packing
                     # for storms, the per-object (objects x chunks)
@@ -239,48 +320,65 @@ class PowDispatcher:
                     # latency-optimal launch for one tiny object) and
                     # keeps slabs dispatched ahead of harvest
                     try:
+                        inject("pow.device_launch")
                         from .pipeline import solve_batch_pipelined
                         self.last_backend = "tpu-pallas-batch"
                         ATTEMPTS.labels(backend=self.last_backend).inc()
                         results = solve_batch_pipelined(
-                            items, should_stop=should_stop)
+                            items, should_stop=should_stop,
+                            start_nonces=starts, progress=progress,
+                            stall_timeout=self.stall_timeout)
+                        pb.record_success()
                     except PowInterrupted:
+                        pb.release_probe()
                         raise
-                    except Exception:
-                        # latch off like the per-object ladder: a broken
-                        # Mosaic kernel must not re-pay a ~75 s failed
-                        # compile on every subsequent batch
+                    except Exception as exc:
+                        # breaker opens like the per-object ladder: a
+                        # broken Mosaic kernel must not re-pay a ~75 s
+                        # failed compile on every subsequent batch
                         logger.exception(
                             "batched Pallas PoW failed; falling back to "
                             "per-object solves")
-                        self._pallas_enabled = False
-                        FALLBACKS.labels(
-                            **{"from": "tpu-pallas", "to": "ladder"}).inc()
+                        self._pallas_failed(exc, "ladder")
             if (results is None and len(items) == 1 and self._tpu_enabled
-                    and self._pallas_enabled and self._on_accelerator()
-                    and self._device_count() <= 1):
+                    and self._on_accelerator()
+                    and self._device_count() <= 1 and pb.allow()):
                 # degenerate case: ONE object.  If it is tiny (expected
                 # to finish inside the first small launch) the pipeline
                 # takes its latency-optimal synchronous path instead of
                 # paying a full production slab + speculative dispatch.
                 try:
+                    inject("pow.device_launch")
                     from .pipeline import plan_batch, solve_batch_pipelined
                     if plan_batch(items).mode == "single-sync":
                         self.last_backend = "tpu-pallas-batch"
                         ATTEMPTS.labels(backend=self.last_backend).inc()
                         results = solve_batch_pipelined(
-                            items, should_stop=should_stop)
+                            items, should_stop=should_stop,
+                            start_nonces=starts, progress=progress,
+                            stall_timeout=self.stall_timeout)
+                        pb.record_success()
+                    else:
+                        pb.release_probe()
                 except PowInterrupted:
+                    pb.release_probe()
                     raise
-                except Exception:
+                except Exception as exc:
                     logger.exception(
                         "pipelined single-object PoW failed; using the "
                         "ladder")
+                    self._pallas_failed(exc, "ladder")
                     results = None
             if results is None:
-                results = [self._solve(ih, t, 0, should_stop)
-                           for ih, t in items]
+                results = []
+                for i, (ih, t) in enumerate(items):
+                    prog = None
+                    if progress is not None:
+                        prog = (lambda n, _i=i: progress(_i, n))
+                    results.append(self._solve(ih, t, starts[i],
+                                               should_stop, progress=prog))
             span.attrs["backend"] = self.last_backend
+        self._record_recovery()
         dt = max(time.monotonic() - t0, 1e-9)
         trials = sum(r[1] for r in results)
         self.last_solve_seconds = dt
@@ -307,41 +405,55 @@ class PowDispatcher:
             return {"lanes": 1 << 12, "chunks_per_call": 8}
         return {}
 
-    def _solve(self, initial_hash, target, start_nonce, should_stop):
-        if self._tpu_enabled:
+    def _pallas_failed(self, exc: Exception, to: str) -> None:
+        """Bookkeeping shared by every Mosaic-tier failure path."""
+        self._note_stall(exc)
+        self.breakers["tpu-pallas"].record_failure()
+        ERRORS.labels(site="pow.tier.tpu-pallas").inc()
+        FALLBACKS.labels(**{"from": "tpu-pallas", "to": to}).inc()
+
+    def _solve(self, initial_hash, target, start_nonce, should_stop,
+               progress=None):
+        tb = self.breakers["tpu"]
+        pb = self.breakers["tpu-pallas"]
+        if self._tpu_enabled and tb.allow():
             try:
+                inject("pow.device_launch")
                 ndev = self._device_count()
                 if ndev > 1:
                     # pod-wide nonce partition over ICI, production
                     # Pallas kernel per chip (VERDICT r2 #1: the pod
                     # tier must not run the 3.3x-slower XLA kernel)
-                    if self._pallas_enabled and self._on_accelerator():
+                    if self._on_accelerator() and pb.allow():
                         try:
                             from ..parallel import pallas_sharded_solve
                             self.last_backend = "tpu-pallas-sharded"
                             ATTEMPTS.labels(backend=self.last_backend).inc()
-                            return pallas_sharded_solve(
+                            result = pallas_sharded_solve(
                                 initial_hash, target, self._mesh(ndev, 1),
                                 start_nonce=start_nonce,
                                 should_stop=should_stop)
+                            pb.record_success()
+                            tb.record_success()
+                            return result
                         except PowInterrupted:
+                            pb.release_probe()
                             raise
-                        except Exception:
+                        except Exception as exc:
                             logger.exception(
                                 "sharded Pallas PoW failed; using "
                                 "sharded XLA search")
-                            self._pallas_enabled = False
-                            FALLBACKS.labels(
-                                **{"from": "tpu-pallas",
-                                   "to": "tpu-xla"}).inc()
+                            self._pallas_failed(exc, "tpu-xla")
                     from ..parallel import sharded_solve
                     self.last_backend = "tpu-sharded"
                     ATTEMPTS.labels(backend=self.last_backend).inc()
-                    return sharded_solve(
+                    result = sharded_solve(
                         initial_hash, target, self._mesh(ndev, 1),
                         start_nonce=start_nonce, should_stop=should_stop,
                         **self._xla_kwargs())
-                if self._pallas_enabled and self._on_accelerator():
+                    tb.record_success()
+                    return result
+                if self._on_accelerator() and pb.allow():
                     # Mosaic kernel: ~3.3x the XLA path on a v5e chip
                     # (84.6 vs 25.8 MH/s, BASELINE.md) — the fastest
                     # usable backend leads the ladder, reference
@@ -351,18 +463,21 @@ class PowDispatcher:
                         from .pipeline import AUTOTUNER
                         self.last_backend = "tpu-pallas"
                         ATTEMPTS.labels(backend=self.last_backend).inc()
-                        return pl_solve(initial_hash, target,
-                                        start_nonce=start_nonce,
-                                        should_stop=should_stop,
-                                        tuner=AUTOTUNER)
+                        result = pl_solve(initial_hash, target,
+                                          start_nonce=start_nonce,
+                                          should_stop=should_stop,
+                                          tuner=AUTOTUNER,
+                                          progress=progress)
+                        pb.record_success()
+                        tb.record_success()
+                        return result
                     except PowInterrupted:
+                        pb.release_probe()
                         raise
-                    except Exception:
+                    except Exception as exc:
                         logger.exception(
                             "Pallas PoW failed; using XLA search")
-                        self._pallas_enabled = False
-                        FALLBACKS.labels(
-                            **{"from": "tpu-pallas", "to": "tpu-xla"}).inc()
+                        self._pallas_failed(exc, "tpu-xla")
                 from ..ops.pow_search import solve as tpu_solve
                 self.last_backend = "tpu"
                 ATTEMPTS.labels(backend=self.last_backend).inc()
@@ -373,34 +488,49 @@ class PowDispatcher:
                     # of the hardcoded 2^19 x 64 constant
                     from .pipeline import AUTOTUNER
                     kwargs = dict(kwargs, tuner=AUTOTUNER)
-                return tpu_solve(initial_hash, target,
-                                 start_nonce=start_nonce,
-                                 should_stop=should_stop,
-                                 **kwargs)
+                result = tpu_solve(initial_hash, target,
+                                   start_nonce=start_nonce,
+                                   should_stop=should_stop,
+                                   progress=progress,
+                                   **kwargs)
+                tb.record_success()
+                return result
             except PowInterrupted:
+                tb.release_probe()
                 raise
-            except Exception:
+            except Exception as exc:
+                self._note_stall(exc)
+                tb.record_failure()
+                ERRORS.labels(site="pow.tier.tpu").inc()
                 logger.exception(
                     "TPU PoW failed; falling through to C++ "
-                    "(reference resetPoW semantics)")
-                self._tpu_enabled = False
+                    "(breaker open, half-open probe after cooldown)")
                 next_tier = ("native"
                              if self._native is not None
                              and self._native.available else "python")
                 FALLBACKS.labels(**{"from": "tpu", "to": next_tier}).inc()
         if self._native is not None and self._native.available:
-            try:
-                self.last_backend = "cpp"
-                ATTEMPTS.labels(backend=self.last_backend).inc()
-                return self._native.solve(initial_hash, target,
-                                          start_nonce=start_nonce,
-                                          should_stop=should_stop)
-            except PowInterrupted:
-                raise
-            except Exception:
-                logger.exception("C++ PoW failed; falling through to python")
-                FALLBACKS.labels(**{"from": "native", "to": "python"}).inc()
+            cb = self.breakers["cpp"]
+            if cb.allow():
+                try:
+                    self.last_backend = "cpp"
+                    ATTEMPTS.labels(backend=self.last_backend).inc()
+                    result = self._native.solve(initial_hash, target,
+                                                start_nonce=start_nonce,
+                                                should_stop=should_stop)
+                    cb.record_success()
+                    return result
+                except PowInterrupted:
+                    cb.release_probe()
+                    raise
+                except Exception:
+                    cb.record_failure()
+                    ERRORS.labels(site="pow.tier.cpp").inc()
+                    logger.exception(
+                        "C++ PoW failed; falling through to python")
+                    FALLBACKS.labels(
+                        **{"from": "native", "to": "python"}).inc()
         self.last_backend = "python"
         ATTEMPTS.labels(backend=self.last_backend).inc()
         return python_solve(initial_hash, target, start_nonce=start_nonce,
-                            should_stop=should_stop)
+                            should_stop=should_stop, progress=progress)

@@ -50,6 +50,8 @@ import jax.numpy as jnp
 
 from ..observability import DEFAULT_SIZE_BUCKETS, REGISTRY
 from ..ops.pow_search import PowInterrupted
+from ..resilience.chaos import inject
+from ..resilience.watchdog import STALLS, SlabStallError
 from ..ops.sha512_jax import double_sha512_trial
 from ..ops.sha512_pallas import (DEFAULT_ROWS, LANE_COLS,
                                  pallas_packed_search)
@@ -335,14 +337,54 @@ class _PipelineDriver:
 
     def __init__(self, *, depth: int = 2,
                  should_stop: Callable[[], bool] | None = None,
-                 fetch=None):
+                 fetch=None, stall_timeout: float = 0.0):
         import numpy as np
+
+        def default_fetch(dev):
+            # chaos site: a failed/poisoned device->host transfer
+            inject("pow.readback")
+            return np.asarray(dev)
+
         self.depth = max(1, depth)
         self.should_stop = should_stop
-        self.fetch = fetch or np.asarray
+        self.fetch = fetch or default_fetch
+        #: per-harvest stall deadline (0 disables the watchdog); a
+        #: wedged transfer raises SlabStallError out of run(), which
+        #: the dispatcher treats as a tier failure and requeues the
+        #: batch to the next ladder tier
+        self.stall_timeout = stall_timeout
+        #: one reusable guard worker per driver — the guarded path must
+        #: not pay a thread spawn per harvest; only a stall abandons it
+        #: (the wedged thread keeps the old executor, a fresh one takes
+        #: over)
+        self._guard_pool = None
         self.wait_seconds = 0.0
         self.wall_seconds = 0.0
         self.slabs = 0
+
+    def _fetch(self, dev):
+        if not self.stall_timeout or self.stall_timeout <= 0:
+            return self.fetch(dev)
+        import concurrent.futures as cf
+        if self._guard_pool is None:
+            self._guard_pool = cf.ThreadPoolExecutor(
+                1, thread_name_prefix="pow-slab-guard")
+        fut = self._guard_pool.submit(self.fetch, dev)
+        try:
+            return fut.result(self.stall_timeout)
+        except cf.TimeoutError:
+            STALLS.labels(site="pow.slab").inc()
+            logger.error("pow.slab stalled: harvest exceeded %.1fs; "
+                         "abandoning the launch and falling back",
+                         self.stall_timeout)
+            # consume whatever the wedged worker eventually produces so
+            # its late exception is not reported as never-retrieved
+            fut.add_done_callback(lambda f: f.exception())
+            self._guard_pool.shutdown(wait=False)
+            self._guard_pool = None
+            raise SlabStallError(
+                "pow.slab exceeded %.1fs stall deadline"
+                % self.stall_timeout)
 
     def run(self, next_launch, harvest, done=None) -> None:
         inflight: deque = deque()
@@ -361,7 +403,7 @@ class _PipelineDriver:
                     # may hold the answer the caller checkpoints on
                     while inflight:
                         tag, dev = inflight.popleft()
-                        harvest(tag, self.fetch(dev))
+                        harvest(tag, self._fetch(dev))
                     raise PowInterrupted("pipelined PoW interrupted")
                 while len(inflight) < self.depth:
                     nxt = next_launch()
@@ -375,7 +417,7 @@ class _PipelineDriver:
                 DISPATCH_AHEAD.observe(len(inflight))
                 tag, dev = inflight.popleft()
                 t0 = time.monotonic()
-                host = self.fetch(dev)
+                host = self._fetch(dev)
                 dt = time.monotonic() - t0
                 self.wait_seconds += dt
                 DEVICE_WAIT.observe(dt)
@@ -383,6 +425,9 @@ class _PipelineDriver:
                 harvest(tag, host)
         finally:
             PIPELINE_DEPTH.set(0)
+            if self._guard_pool is not None:
+                self._guard_pool.shutdown(wait=False)
+                self._guard_pool = None
             self.wall_seconds = max(time.monotonic() - t_start, 1e-9)
             DEVICE_BUSY.set(self.busy_ratio)
 
@@ -404,7 +449,7 @@ class _LaunchGroup:
     __slots__ = ("idx", "ih_words", "targets", "t_arr", "bases",
                  "trials", "done", "launches", "width")
 
-    def __init__(self, items, idx, width):
+    def __init__(self, items, idx, width, starts=None):
         import numpy as np
 
         pad = width - len(idx)
@@ -421,7 +466,10 @@ class _LaunchGroup:
             dtype=np.uint32)
         self.idx = list(idx)
         self.width = width
-        self.bases = [0] * width
+        # resumable PoW: each object's search starts at its journaled
+        # checkpoint offset instead of 0 (pad slots stay at 0)
+        self.bases = ([(starts[i] if starts else 0) & _MASK64
+                       for i in idx] + [0] * pad)
         self.trials = [0] * width
         self.done = [i >= len(idx) for i in range(width)]
         self.launches = 0
@@ -448,7 +496,9 @@ def solve_batch_pipelined(items, *, rows: int = DEFAULT_ROWS,
                           autotuner: SlabAutotuner | None = None,
                           plan: BatchPlan | None = None,
                           stats: dict | None = None,
-                          should_stop: Callable[[], bool] | None = None):
+                          should_stop: Callable[[], bool] | None = None,
+                          start_nonces=None, progress=None,
+                          stall_timeout: float = 0.0):
     """Solve ``[(initial_hash, target), ...]`` through the async
     double-buffered pipeline.  Returns ``[(nonce, trials), ...]``
     aligned with ``items``; raises :class:`PowInterrupted` on
@@ -465,6 +515,14 @@ def solve_batch_pipelined(items, *, rows: int = DEFAULT_ROWS,
     object itself searched, while ``stats["executed_trials"]``
     estimates total device hashing including straggler and pad waste —
     the two diverge exactly where packing removes waste.
+
+    Resilience hooks (docs/resilience.md): ``start_nonces`` resumes
+    each object from a checkpointed offset; ``progress(i, next)`` is
+    invoked at every harvest with the end of the slab range just
+    proven miss-free for item ``i`` (safe resume point — speculative
+    dispatch-ahead never moves a checkpoint before its slab is
+    harvested); ``stall_timeout > 0`` bounds each harvest's blocking
+    device wait.
     """
     import numpy as np
 
@@ -480,11 +538,13 @@ def solve_batch_pipelined(items, *, rows: int = DEFAULT_ROWS,
     PIPELINE_MODE.labels(mode=plan.mode).inc()
 
     if plan.mode == "single-sync":
-        return [_solve_single_sync(items[0], rows=rows, unroll=unroll,
-                                   chunks=plan.chunks, impl=impl,
-                                   interpret=interpret,
-                                   autotuner=autotuner,
-                                   should_stop=should_stop)]
+        return [_solve_single_sync(
+            items[0], rows=rows, unroll=unroll,
+            chunks=plan.chunks, impl=impl, interpret=interpret,
+            autotuner=autotuner, should_stop=should_stop,
+            start_nonce=(start_nonces[0] if start_nonces else 0),
+            progress=(None if progress is None
+                      else (lambda nxt: progress(0, nxt))))]
 
     if plan.mode == "packed":
         pack = plan.pack
@@ -504,7 +564,8 @@ def solve_batch_pipelined(items, *, rows: int = DEFAULT_ROWS,
     slab_trials = step_trials * plan.chunks     # per object per launch
 
     groups = [
-        _LaunchGroup(items, plan.order[s:s + width], width)
+        _LaunchGroup(items, plan.order[s:s + width], width,
+                     starts=start_nonces)
         for s in range(0, n, width)
     ]
     results: list = [None] * n
@@ -566,10 +627,14 @@ def solve_batch_pipelined(items, *, rows: int = DEFAULT_ROWS,
         for k in range(cand.width):
             if not cand.done[k]:
                 cand.bases[k] = (cand.bases[k] + slab_trials) & _MASK64
-        return ((cand, t0), out)
+        # snapshot of each object's post-slab offset: the safe resume
+        # point to checkpoint once THIS slab harvests miss-free (the
+        # live ``bases`` may already include speculative launches)
+        end_bases = list(cand.bases)
+        return ((cand, t0, end_bases), out)
 
     def harvest(tag, out):
-        g, t0 = tag
+        g, t0, end_bases = tag
         inflight_groups.discard(id(g))
         # normalize by the launch's total grid steps so storm-wide and
         # narrow launches feed one per-step EWMA
@@ -597,8 +662,13 @@ def solve_batch_pipelined(items, *, rows: int = DEFAULT_ROWS,
             else:
                 g.trials[k] += slab_trials
                 executed["trials"] += slab_trials
+                if progress is not None:
+                    # this slab proved [prev, end_bases[k]) miss-free:
+                    # a resumed search may safely start there
+                    progress(g.idx[k], end_bases[k])
 
-    driver = _PipelineDriver(depth=depth, should_stop=should_stop)
+    driver = _PipelineDriver(depth=depth, should_stop=should_stop,
+                             stall_timeout=stall_timeout)
     try:
         driver.run(next_launch, harvest,
                    done=lambda: all(r is not None for r in results))
@@ -619,7 +689,8 @@ def solve_batch_pipelined(items, *, rows: int = DEFAULT_ROWS,
 def _solve_single_sync(item, *, rows: int, unroll: int, chunks: int,
                        impl: str, interpret: bool,
                        autotuner: SlabAutotuner,
-                       should_stop: Callable[[], bool] | None):
+                       should_stop: Callable[[], bool] | None,
+                       start_nonce: int = 0, progress=None):
     """Latency-optimal degenerate path: one object, small synchronous
     launches, no speculative dispatch-ahead (an extra in-flight slab
     would only delay the answer for work expected to finish in the
@@ -635,7 +706,7 @@ def _solve_single_sync(item, *, rows: int, unroll: int, chunks: int,
     step_trials = rows * LANE_COLS * unroll
     slab_trials = step_trials * chunks
 
-    base = 0
+    base = start_nonce & _MASK64
     trials = 0
     while True:
         if should_stop is not None and should_stop():
@@ -654,6 +725,7 @@ def _solve_single_sync(item, *, rows: int, unroll: int, chunks: int,
         else:
             out = _packed_search_xla(ih_words, b_arr, t_arr,
                                      lanes=step_trials, chunks=chunks)
+        inject("pow.readback")
         out = np.asarray(out)
         autotuner.record("packed", chunks, time.monotonic() - t0)
         step1 = int(out[0, 0])
@@ -667,6 +739,8 @@ def _solve_single_sync(item, *, rows: int, unroll: int, chunks: int,
             return nonce, trials
         trials += slab_trials
         base = (base + slab_trials) & _MASK64
+        if progress is not None:
+            progress(base)
 
 
 def pipeline_snapshot() -> dict:

@@ -12,6 +12,18 @@ A single queued object never waits more than ``window`` seconds (the
 latency/batching tradeoff called out in SURVEY §7: dynamic batch
 assembly with padding, no recompilation per batch size thanks to the
 object-axis padding in ``sharded_solve_batch``).
+
+Resilience (ISSUE 3, docs/resilience.md):
+
+- a dispatcher failure REQUEUES the in-flight batch with exponential
+  backoff instead of dropping it — a transient tier failure never
+  loses a queued object; only ``max_attempts`` consecutive failures
+  surface the error to the caller (and the job stays journaled);
+- with a :class:`~pybitmessage_tpu.resilience.journal.PowJournal`
+  attached, every request is journaled before it is queued, search
+  progress is checkpointed as slabs harvest, and completion deletes
+  the row — queued/in-flight objects survive a process crash and a
+  resumed solve continues from its checkpointed nonce offset.
 """
 
 from __future__ import annotations
@@ -19,8 +31,12 @@ from __future__ import annotations
 import asyncio
 import logging
 import time
+from dataclasses import dataclass, field
 
 from ..observability import DEFAULT_SIZE_BUCKETS, REGISTRY
+from ..ops.pow_search import PowInterrupted
+from ..resilience import RetryPolicy
+from ..resilience.policy import ERRORS
 
 logger = logging.getLogger("pybitmessage_tpu.pow")
 
@@ -38,22 +54,63 @@ BATCHES = REGISTRY.counter(
     "pow_batches_total", "Coalesced solve_batch launches")
 SOLVED = REGISTRY.counter(
     "pow_solved_total", "Solve requests completed through the service")
+REQUEUED = REGISTRY.counter(
+    "pow_requeue_total",
+    "Solve requests put back on the queue after a dispatcher failure "
+    "or interrupt — the no-object-loss path", ("reason",))
 
 #: default coalescing window in seconds; overridable per node via the
 #: ``powbatchwindow`` setting (core/config.py)
 DEFAULT_WINDOW = 0.05
 
 
+@dataclass
+class _Request:
+    initial_hash: bytes
+    target: int
+    future: asyncio.Future
+    enqueued: float
+    job_id: int | None = None
+    start_nonce: int = 0
+    attempts: int = 0
+    #: monotonic time of the last journal checkpoint (write throttle)
+    last_checkpoint: float = field(default=0.0)
+
+
 class PowService:
     """Owns a background task that drains solve requests in batches."""
 
+    #: minimum seconds between journal checkpoint writes per request
+    CHECKPOINT_INTERVAL = 0.2
+
     def __init__(self, dispatcher, *, shutdown: asyncio.Event | None = None,
-                 window: float | None = None):
+                 window: float | None = None, journal=None,
+                 max_attempts: int = 3, retry: RetryPolicy | None = None):
         self.dispatcher = dispatcher
         self.shutdown = shutdown or asyncio.Event()
         self.window = DEFAULT_WINDOW if window is None else window
+        self.journal = journal
+        self.max_attempts = max(1, max_attempts)
+        #: backoff between requeued batches (async sleeps in _run)
+        self.retry = retry or RetryPolicy(attempts=self.max_attempts,
+                                          base_delay=0.2, max_delay=5.0)
+        #: journal writes run inline on the event loop, so their retry
+        #: budget is µs-scale sqlite work + at most ~60 ms of backoff —
+        #: NEVER the batch policy above (whose sleeps would stall all
+        #: network/API I/O while a broken journal thrashes)
+        self._journal_retry = RetryPolicy(attempts=3, base_delay=0.01,
+                                          max_delay=0.05, jitter=0.0)
         self.queue: asyncio.Queue = asyncio.Queue()
         self._task: asyncio.Task | None = None
+        # injected solvers may predate the resumable-PoW kwargs —
+        # detect once and degrade to the plain call shape
+        import inspect
+        try:
+            params = inspect.signature(dispatcher.solve_batch).parameters
+            self._resumable = ("start_nonces" in params or any(
+                p.kind == p.VAR_KEYWORD for p in params.values()))
+        except (TypeError, ValueError):
+            self._resumable = False
         # batch/solve bookkeeping lives ONLY in the registry counters;
         # per-instance views subtract the construction-time baseline so
         # a fresh service still reports its own counts
@@ -82,12 +139,57 @@ class PowService:
             except asyncio.CancelledError:
                 pass
 
+    # -- journal plumbing ----------------------------------------------------
+
+    def _journal_call(self, fn, site: str):
+        """Run one journal write, absorbing transient failures with a
+        bounded retry; a persistently broken journal degrades to
+        un-journaled operation instead of failing the solve."""
+        if self.journal is None:
+            return None
+        try:
+            return self._journal_retry.call(fn, site=site)
+        except Exception:
+            ERRORS.labels(site=site).inc()
+            logger.exception("PoW journal write failed (%s); continuing "
+                             "without journal durability", site)
+            return None
+
+    def _checkpoint(self, req: _Request, next_nonce: int) -> None:
+        """Progress hook from the dispatcher (executor thread)."""
+        req.start_nonce = max(req.start_nonce, next_nonce)
+        if self.journal is None or req.job_id is None:
+            return
+        now = time.monotonic()
+        if now - req.last_checkpoint < self.CHECKPOINT_INTERVAL:
+            return
+        req.last_checkpoint = now
+        try:
+            self.journal.checkpoint(req.job_id, next_nonce)
+        except Exception:
+            ERRORS.labels(site="pow.journal.checkpoint").inc()
+            logger.debug("journal checkpoint failed for job %s",
+                         req.job_id, exc_info=True)
+
+    # -- API -----------------------------------------------------------------
+
     async def solve(self, initial_hash: bytes, target: int):
         """Queue one solve; returns (nonce, trials) when its batch lands."""
         fut = asyncio.get_running_loop().create_future()
-        await self.queue.put((initial_hash, target, fut, time.monotonic()))
+        req = _Request(initial_hash, target, fut, time.monotonic())
+        journaled = self._journal_call(
+            lambda: self.journal.add(initial_hash, target),
+            site="pow.journal.add")
+        if journaled is not None:
+            req.job_id, req.start_nonce = journaled
+            if req.start_nonce:
+                logger.info("resuming journaled PoW job %d from nonce "
+                            "offset %d", req.job_id, req.start_nonce)
+        await self.queue.put(req)
         QUEUE_DEPTH.set(self.queue.qsize())
         return await fut
+
+    # -- drain loop ----------------------------------------------------------
 
     async def _run(self) -> None:
         while True:
@@ -98,31 +200,100 @@ class PowService:
             while not self.queue.empty():
                 batch.append(self.queue.get_nowait())
             now = time.monotonic()
-            for *_, enqueued in batch:
-                QUEUE_WAIT.observe(now - enqueued)
+            for req in batch:
+                QUEUE_WAIT.observe(now - req.enqueued)
             BATCH_SIZE.observe(len(batch))
             QUEUE_DEPTH.set(self.queue.qsize())
-            items = [(ih, t) for ih, t, _, _ in batch]
+            items = [(r.initial_hash, r.target) for r in batch]
+            starts = [r.start_nonce for r in batch]
+            for req in batch:
+                if req.job_id is not None:
+                    self._journal_call(
+                        lambda j=req.job_id: self.journal.mark_inflight(j),
+                        site="pow.journal.inflight")
+
+            def progress(i, next_nonce, _batch=batch):
+                self._checkpoint(_batch[i], next_nonce)
+
+            kwargs = {"should_stop": self.shutdown.is_set}
+            if self._resumable:
+                kwargs.update(start_nonces=starts, progress=progress)
             loop = asyncio.get_running_loop()
             try:
                 results = await loop.run_in_executor(
                     None, lambda: self.dispatcher.solve_batch(
-                        items, should_stop=self.shutdown.is_set))
+                        items, **kwargs))
             except asyncio.CancelledError:
-                for _, _, fut, _ in batch:
-                    if not fut.done():
-                        fut.cancel()
+                self._settle_interrupted(batch)
                 raise
+            except PowInterrupted:
+                # shutdown-driven: jobs stay journaled for the next
+                # process; the futures cancel so callers unwind
+                self._settle_interrupted(batch)
+                continue
             except Exception as exc:
-                for _, _, fut, _ in batch:
-                    if not fut.done():
-                        fut.set_exception(exc)
+                await self._requeue_failed(batch, exc)
                 continue
             BATCHES.inc()
             SOLVED.inc(len(batch))
             if len(batch) > 1:
                 logger.info("batched PoW: %d objects in one launch (%s)",
                             len(batch), self.dispatcher.last_backend)
-            for (_, _, fut, _), res in zip(batch, results):
-                if not fut.done():
-                    fut.set_result(res)
+            for req, res in zip(batch, results):
+                if req.job_id is not None:
+                    self._journal_call(
+                        lambda j=req.job_id: self.journal.complete(j),
+                        site="pow.journal.complete")
+                if not req.future.done():
+                    req.future.set_result(res)
+
+    def _settle_interrupted(self, batch: list[_Request]) -> None:
+        REQUEUED.labels(reason="interrupt").inc(len(batch))
+        for req in batch:
+            if req.job_id is not None:
+                self._journal_call(
+                    lambda j=req.job_id: self.journal.requeue(j),
+                    site="pow.journal.requeue")
+            if not req.future.done():
+                req.future.cancel()
+
+    async def _requeue_failed(self, batch: list[_Request],
+                              exc: Exception) -> None:
+        """A dispatcher failure must never lose a queued object: every
+        request goes back on the queue (with backoff) until it exceeds
+        ``max_attempts``; exhausted requests surface the error to the
+        caller but STAY journaled for the next process."""
+        survivors = []
+        for req in batch:
+            req.attempts += 1
+            if req.job_id is not None:
+                self._journal_call(
+                    lambda j=req.job_id: self.journal.requeue(j),
+                    site="pow.journal.requeue")
+            if req.attempts >= self.max_attempts:
+                REQUEUED.labels(reason="exhausted").inc()
+                logger.error(
+                    "PoW solve failed after %d attempts; surfacing the "
+                    "error to the caller (job stays journaled)",
+                    req.attempts)
+                if not req.future.done():
+                    req.future.set_exception(exc)
+            else:
+                survivors.append(req)
+        if not survivors:
+            return
+        REQUEUED.labels(reason="failure").inc(len(survivors))
+        attempt = min(r.attempts for r in survivors) - 1
+        pause = self.retry.delay(attempt)
+        logger.warning(
+            "dispatcher failed (%r); requeueing %d solve(s), attempt "
+            "%d/%d after %.2fs backoff", exc, len(survivors),
+            attempt + 2, self.max_attempts, pause)
+        try:
+            await asyncio.sleep(pause)
+        except asyncio.CancelledError:
+            self._settle_interrupted(survivors)
+            raise
+        for req in survivors:
+            self.queue.put_nowait(req)
+        QUEUE_DEPTH.set(self.queue.qsize())

@@ -122,6 +122,8 @@ class BatchVerifier:
                     VERIFIED.labels(path="device").inc(len(batch))
                     VERIFY_BATCHES.inc()
                 except Exception:
+                    from ..resilience.policy import ERRORS
+                    ERRORS.labels(site="pow.verify_device").inc()
                     logger.exception(
                         "device PoW verification failed; host fallback")
             if results is None:

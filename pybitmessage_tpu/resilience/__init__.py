@@ -1,0 +1,38 @@
+"""Resilience subsystem: failure paths engineered like the hot path.
+
+Four pillars (ISSUE 3, docs/resilience.md):
+
+- :mod:`.policy` — composable retry with exponential backoff + jitter,
+  deadline propagation, and circuit breakers (open after N consecutive
+  failures, half-open probe to recover) adopted by the PoW dispatcher
+  ladder, the connection pool dialer, the API server, and storage
+  writes;
+- :mod:`.chaos` — a config/env-driven fault-injection registry with
+  named sites planted in the hot paths, deterministic under a seed, so
+  every failure path is testable on demand (``make chaos``);
+- :mod:`.journal` — a crash-safe SQLite PoW job journal: queued and
+  in-flight solves survive a process crash, and per-object search
+  progress is checkpointed so a resumed solve continues from its last
+  completed chunk offset instead of nonce 0;
+- :mod:`.watchdog` — slab-stall detection: an overdue device launch is
+  abandoned, counted, and the object requeued to the next ladder tier.
+
+Everything reports through ``observability.REGISTRY`` following the
+conventions in docs/observability.md.
+"""
+
+from .chaos import CHAOS, ChaosError, ChaosRegistry, inject
+from .journal import PowJob, PowJournal
+from .policy import (BREAKERS, ERRORS, BreakerOpen, CircuitBreaker,
+                     Deadline, DeadlineExceeded, RetryPolicy,
+                     breaker_snapshot, current_deadline)
+from .watchdog import SlabStallError, StallGuard
+
+__all__ = [
+    "RetryPolicy", "Deadline", "DeadlineExceeded", "current_deadline",
+    "CircuitBreaker", "BreakerOpen", "BREAKERS", "breaker_snapshot",
+    "ERRORS",
+    "ChaosRegistry", "ChaosError", "CHAOS", "inject",
+    "PowJournal", "PowJob",
+    "StallGuard", "SlabStallError",
+]

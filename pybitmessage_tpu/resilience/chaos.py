@@ -1,0 +1,160 @@
+"""Config/env-driven fault injection with named sites.
+
+Hot paths plant ``inject("<site>")`` markers; the registry decides —
+deterministically under a seed — whether that call raises.  Disarmed
+(the production default) an injection site is one dict lookup, far
+below the instrumentation budget.
+
+Site catalog (docs/resilience.md keeps the authoritative table):
+
+==================  =====================================================
+``pow.device_launch``  entering a device solve tier (dispatcher ladder)
+``pow.readback``       pulling slab results to the host (pipeline fetch)
+``db.write``           a SQLite write (storage/db.py + the PoW journal)
+``net.dial``           an outbound dial (``ConnectionPool.connect_to``)
+``net.send``           a framed packet send (``BMConnection.send_packet``)
+``api.dispatch``       an RPC command dispatch (API server)
+==================  =====================================================
+
+Arming, one of:
+
+- env: ``BMTPU_CHAOS="pow.device_launch:0.5,db.write:1.0x3"`` (+
+  ``BMTPU_CHAOS_SEED=1234``) — ``site:probability`` entries, optional
+  ``xN`` capping total fires;
+- code: ``CHAOS.arm("net.send", probability=1.0, count=3)``.
+
+Determinism: each site draws from its own ``random.Random`` seeded
+with ``(seed, site)``, so a given (seed, call sequence) always fires
+the same calls regardless of other sites' traffic.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import random
+import threading
+
+from ..observability import REGISTRY
+
+logger = logging.getLogger("pybitmessage_tpu.resilience")
+
+FAULTS = REGISTRY.counter(
+    "chaos_injected_total",
+    "Faults raised by the chaos registry", ("site",))
+
+
+class ChaosError(RuntimeError):
+    """The default injected fault (sites may configure another type)."""
+
+
+#: realistic default exception per site family — network faults should
+#: exercise the same handlers a dead peer does
+_DEFAULT_EXC: dict[str, type] = {
+    "net.dial": OSError,
+    "net.send": ConnectionError,
+}
+
+
+class _Site:
+    __slots__ = ("probability", "count", "exc", "delay", "fired", "rng")
+
+    def __init__(self, probability: float, count: int | None,
+                 exc: type, delay: float, rng: random.Random):
+        self.probability = probability
+        self.count = count          # None = unlimited
+        self.exc = exc
+        self.delay = delay          # sleep before raising (stall sim)
+        self.fired = 0
+        self.rng = rng
+
+
+class ChaosRegistry:
+    """Named injection sites, armed per test run or via env."""
+
+    def __init__(self, seed: int = 0):
+        self._lock = threading.Lock()
+        self._sites: dict[str, _Site] = {}
+        self._seed = seed
+
+    # -- configuration -------------------------------------------------------
+
+    def arm(self, site: str, probability: float = 1.0, *,
+            count: int | None = None, exc: type | None = None,
+            delay: float = 0.0) -> None:
+        """Arm one site.  ``count`` caps total fires; ``delay`` sleeps
+        before raising (simulates a stalled launch for the watchdog)."""
+        exc = exc or _DEFAULT_EXC.get(site, ChaosError)
+        rng = random.Random("%d:%s" % (self._seed, site))
+        with self._lock:
+            self._sites[site] = _Site(probability, count, exc, delay, rng)
+        logger.info("chaos armed: %s p=%.2f count=%s delay=%.2fs (%s)",
+                    site, probability, count, delay, exc.__name__)
+
+    def disarm(self, site: str | None = None) -> None:
+        with self._lock:
+            if site is None:
+                self._sites.clear()
+            else:
+                self._sites.pop(site, None)
+
+    def seed(self, seed: int) -> None:
+        """Set the seed for sites armed AFTER this call."""
+        self._seed = seed
+
+    def configure(self, spec: str, seed: int | None = None) -> None:
+        """Parse ``site:probability[xCount]`` comma list (env format)."""
+        if seed is not None:
+            self.seed(seed)
+        for entry in spec.split(","):
+            entry = entry.strip()
+            if not entry:
+                continue
+            site, _, rest = entry.partition(":")
+            prob, count = rest or "1.0", None
+            if "x" in prob:
+                prob, _, n = prob.partition("x")
+                count = int(n)
+            self.arm(site.strip(), float(prob or 1.0), count=count)
+
+    def active(self) -> dict[str, dict]:
+        """Armed sites and their fire counts (clientStatus block)."""
+        with self._lock:
+            return {name: {"probability": s.probability,
+                           "count": s.count, "fired": s.fired,
+                           "delay": s.delay}
+                    for name, s in self._sites.items()}
+
+    # -- the hot-path hook ---------------------------------------------------
+
+    def inject(self, site: str) -> None:
+        """Raise the configured fault when ``site`` is armed and its
+        die roll fires; no-op (one dict lookup) otherwise."""
+        if not self._sites:        # disarmed fast path, no lock
+            return
+        with self._lock:
+            s = self._sites.get(site)
+            if s is None:
+                return
+            if s.count is not None and s.fired >= s.count:
+                return
+            if s.probability < 1.0 and s.rng.random() >= s.probability:
+                return
+            s.fired += 1
+            exc, delay = s.exc, s.delay
+        FAULTS.labels(site=site).inc()
+        if delay > 0:
+            import time
+            time.sleep(delay)
+        raise exc("chaos: injected fault at %s" % site)
+
+
+#: the process-wide registry every planted site consults
+CHAOS = ChaosRegistry(seed=int(os.environ.get("BMTPU_CHAOS_SEED", "0")))
+if os.environ.get("BMTPU_CHAOS"):
+    CHAOS.configure(os.environ["BMTPU_CHAOS"])
+
+
+def inject(site: str) -> None:
+    """Module-level shorthand for ``CHAOS.inject(site)``."""
+    CHAOS.inject(site)

@@ -12,10 +12,13 @@ thread + submit/return queues (src/helper_sql.py:24-35).
 
 from __future__ import annotations
 
+import logging
 import sqlite3
 import threading
 import time
 from typing import Any, Iterable, Sequence
+
+logger = logging.getLogger("pybitmessage_tpu.storage")
 
 SCHEMA_VERSION = 11
 
@@ -121,16 +124,54 @@ class Database:
 
     # -- generic access ------------------------------------------------------
 
+    #: transient SQLite write failures retried with backoff before the
+    #: error surfaces (reference helper_sql retries "database is
+    #: locked" the same way); class-level so tests can tighten it
+    WRITE_ATTEMPTS = 3
+
+    def _write_retry(self, fn):
+        """Run one write with bounded backoff on transient failures.
+
+        ``db.write`` is a chaos injection site (docs/resilience.md):
+        injected faults exercise exactly this absorption path.
+        """
+        from ..resilience import RetryPolicy, inject
+        from ..resilience.chaos import ChaosError
+        from ..resilience.policy import ERRORS
+
+        def attempt():
+            inject("db.write")
+            return fn()
+
+        try:
+            return RetryPolicy(attempts=self.WRITE_ATTEMPTS,
+                               base_delay=0.02, max_delay=0.5).call(
+                attempt, site="db.write",
+                retry_on=(sqlite3.OperationalError, ChaosError))
+        except (sqlite3.OperationalError, ChaosError):
+            ERRORS.labels(site="db.write").inc()
+            logger.exception("SQLite write failed after %d attempts",
+                             self.WRITE_ATTEMPTS)
+            raise
+
     def execute(self, sql: str, params: Sequence[Any] = ()) -> int:
         """Run one statement; returns rowcount."""
-        with self._lock:
-            cur = self._conn.cursor()
-            cur.execute(sql, params)
-            return cur.rowcount
+        def run():
+            with self._lock:
+                cur = self._conn.cursor()
+                cur.execute(sql, params)
+                return cur.rowcount
+        if not sql.lstrip()[:6].upper().startswith("SELECT"):
+            return self._write_retry(run)
+        return run()
 
     def executemany(self, sql: str, rows: Iterable[Sequence[Any]]) -> None:
-        with self._lock:
-            self._conn.cursor().executemany(sql, rows)
+        rows = list(rows)
+
+        def run():
+            with self._lock:
+                self._conn.cursor().executemany(sql, rows)
+        self._write_retry(run)
 
     def query(self, sql: str, params: Sequence[Any] = ()) -> list[tuple]:
         with self._lock:

@@ -310,6 +310,48 @@ _SNAKE = re.compile(r"^[a-z][a-z0-9_]*$")
 _HISTOGRAM_UNITS = ("_seconds", "_size", "_bytes")
 
 
+def test_no_silent_exception_swallows():
+    """ISSUE 3 satellite lint: in pow/ and network/, a broad handler
+    (bare ``except:``, ``except Exception``/``BaseException``) whose
+    body is ONLY ``pass``/``...``/``continue`` silently swallows the
+    error — it must log, count a metric, re-raise, or return
+    something.  New swallows fail this test."""
+    import ast
+    import pathlib
+
+    import pybitmessage_tpu
+
+    root = pathlib.Path(pybitmessage_tpu.__file__).parent
+
+    def is_broad(expr) -> bool:
+        if expr is None:            # bare except:
+            return True
+        if isinstance(expr, ast.Tuple):
+            return any(is_broad(e) for e in expr.elts)
+        return isinstance(expr, ast.Name) and \
+            expr.id in ("Exception", "BaseException")
+
+    def is_silent(stmt) -> bool:
+        if isinstance(stmt, (ast.Pass, ast.Continue)):
+            return True
+        return isinstance(stmt, ast.Expr) and \
+            isinstance(stmt.value, ast.Constant)
+
+    offenders = []
+    for pkg in ("pow", "network"):
+        for path in sorted((root / pkg).glob("*.py")):
+            tree = ast.parse(path.read_text(), filename=str(path))
+            for node in ast.walk(tree):
+                if isinstance(node, ast.ExceptHandler) and \
+                        is_broad(node.type) and \
+                        all(is_silent(s) for s in node.body):
+                    offenders.append("%s/%s:%d" % (pkg, path.name,
+                                                   node.lineno))
+    assert not offenders, (
+        "silent broad exception swallows (log + count them instead, "
+        "see docs/resilience.md): %s" % ", ".join(offenders))
+
+
 def test_metric_naming_conventions():
     """Import every instrumented module, then lint the default
     registry: snake_case everywhere, counters end _total, histograms

@@ -118,3 +118,51 @@ async def test_doingpubkeypow_state_written_during_getpubkey_pow():
         assert "doingpubkeypow" in observed
     finally:
         await node.stop()
+
+
+def test_bump_retry_backoff_grows_exponentially_and_survives_reopen(
+        tmp_path):
+    """ISSUE 3 satellite: the storage-level resend schedule.  Each
+    retry doubles the TTL (capped at 28 d) and re-parks the row with a
+    growing sleeptill; retrynumber/ttl/sleeptill are plain sent-table
+    columns, so the whole schedule survives closing and reopening the
+    database file."""
+    from pybitmessage_tpu.storage.db import Database
+    from pybitmessage_tpu.storage.messages import MessageStore
+
+    path = str(tmp_path / "messages.dat")
+    db = Database(path)
+    store = MessageStore(db)
+    ack = b"backoff-ack"
+    store.queue_sent(msgid=b"m1", toaddress="BM-to", toripe=b"r",
+                     fromaddress="BM-from", subject="s", message="b",
+                     ackdata=ack, ttl=600)
+
+    ttls, sleeps = [], []
+    now = int(time.time())
+    for round_no in range(6):
+        m = store.sent_by_ackdata(ack)
+        new_ttl = min(m.ttl * 2, 28 * 24 * 3600)
+        sleeptill = now + int(1.1 * new_ttl)
+        store.bump_retry(ack, new_ttl, sleeptill)
+        m = store.sent_by_ackdata(ack)
+        assert m.retrynumber == round_no + 1
+        ttls.append(m.ttl)
+        sleeps.append(m.sleeptill)
+
+    # exponential: each TTL doubles until the 28d cap
+    for prev, cur in zip([600] + ttls, ttls):
+        assert cur == min(prev * 2, 28 * 24 * 3600)
+    assert ttls[-1] == ttls[-2] * 2 or ttls[-1] == 28 * 24 * 3600
+    # the park horizon grows with the TTL (monotone until the cap)
+    assert sleeps == sorted(sleeps)
+
+    # survives a reopened DB: same file, fresh connection
+    db.close()
+    db2 = Database(path)
+    store2 = MessageStore(db2)
+    m = store2.sent_by_ackdata(ack)
+    assert m.retrynumber == 6
+    assert m.ttl == ttls[-1]
+    assert m.sleeptill == sleeps[-1]
+    db2.close()

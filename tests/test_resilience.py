@@ -1,0 +1,476 @@
+"""Resilience policy-primitive tests (ISSUE 3 tentpole, unit level).
+
+Covers: deterministic retry schedules, deadline propagation, the
+circuit-breaker state machine (open after N consecutive failures,
+half-open probe, recovery), the slab-stall guard, the crash-safe PoW
+journal with checkpoint/resume across reopen, the chaos registry's
+seeded determinism, and the dispatcher/service integration points.
+The fault-driven end-to-end properties live in
+tests/test_resilience_chaos.py.
+"""
+
+import asyncio
+import hashlib
+import random
+import time
+
+import pytest
+
+from pybitmessage_tpu.observability import REGISTRY
+from pybitmessage_tpu.resilience import (
+    CHAOS, BreakerOpen, ChaosError, ChaosRegistry, CircuitBreaker,
+    Deadline, PowJournal, RetryPolicy, SlabStallError, StallGuard,
+    current_deadline)
+
+IH = hashlib.sha512(b"resilience").digest()
+
+
+# ---------------------------------------------------------------------------
+# retry policy
+# ---------------------------------------------------------------------------
+
+
+def test_retry_delays_grow_exponentially_and_clamp():
+    p = RetryPolicy(attempts=6, base_delay=0.1, max_delay=1.0,
+                    multiplier=2.0, jitter=0.0)
+    delays = list(p.delays())
+    assert delays == [0.1, 0.2, 0.4, 0.8, 1.0]
+
+
+def test_retry_jitter_is_deterministic_under_seed():
+    a = RetryPolicy(attempts=5, base_delay=0.1, jitter=0.5,
+                    rng=random.Random(42))
+    b = RetryPolicy(attempts=5, base_delay=0.1, jitter=0.5,
+                    rng=random.Random(42))
+    sched_a, sched_b = list(a.delays()), list(b.delays())
+    assert sched_a == sched_b
+    # jitter bounds: within ±50% of the nominal value
+    for nominal, got in zip([0.1, 0.2, 0.4, 0.8], sched_a):
+        assert 0.5 * nominal <= got <= 1.5 * nominal
+
+
+def test_retry_call_retries_then_succeeds():
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise ValueError("transient")
+        return "ok"
+
+    p = RetryPolicy(attempts=3, base_delay=0.0, jitter=0.0)
+    assert p.call(flaky, site="test.flaky") == "ok"
+    assert len(calls) == 3
+
+
+def test_retry_call_gives_up_with_last_error():
+    p = RetryPolicy(attempts=2, base_delay=0.0, jitter=0.0)
+    with pytest.raises(ValueError, match="persistent"):
+        p.call(lambda: (_ for _ in ()).throw(ValueError("persistent")),
+               site="test.dead")
+
+
+def test_retry_respects_deadline():
+    """A retry whose backoff cannot finish inside the deadline raises
+    the original error instead of sleeping past the budget."""
+    p = RetryPolicy(attempts=5, base_delay=10.0, jitter=0.0)
+    with Deadline(0.05):
+        t0 = time.monotonic()
+        with pytest.raises(ValueError):
+            p.call(lambda: (_ for _ in ()).throw(ValueError("x")),
+                   site="test.deadline")
+        assert time.monotonic() - t0 < 1.0, "must not sleep 10s"
+
+
+# ---------------------------------------------------------------------------
+# deadline propagation
+# ---------------------------------------------------------------------------
+
+
+def test_deadline_context_propagates_and_nests_tighter():
+    assert current_deadline() is None
+    with Deadline(10.0) as outer:
+        assert current_deadline() is outer
+        with Deadline(99.0) as inner:
+            # inner must inherit the TIGHTER outer budget
+            assert inner.expires_at <= outer.expires_at
+        assert current_deadline() is outer
+    assert current_deadline() is None
+
+
+def test_deadline_expiry_check():
+    d = Deadline(-1.0)
+    assert d.expired
+    from pybitmessage_tpu.resilience import DeadlineExceeded
+    with pytest.raises(DeadlineExceeded):
+        d.check("unit op")
+
+
+# ---------------------------------------------------------------------------
+# circuit breaker
+# ---------------------------------------------------------------------------
+
+
+class FakeClock:
+    def __init__(self):
+        self.now = 1000.0
+
+    def __call__(self):
+        return self.now
+
+
+def test_breaker_opens_after_threshold_and_half_open_recovers():
+    clk = FakeClock()
+    br = CircuitBreaker("test.br", threshold=3, cooldown=30.0,
+                        clock=clk, register=False)
+    assert br.state == "closed" and br.allow()
+    br.record_failure()
+    br.record_failure()
+    assert br.state == "closed", "below threshold stays closed"
+    br.record_failure()
+    assert br.state == "open"
+    assert not br.allow(), "open breaker short-circuits"
+    assert not br.available()
+
+    clk.now += 29.0
+    assert not br.allow(), "cooldown not elapsed"
+    clk.now += 2.0
+    assert br.available()
+    assert br.allow(), "half-open admits exactly one probe"
+    assert not br.allow(), "second caller blocked while probe in flight"
+    br.record_success()
+    assert br.state == "closed"
+    assert br.allow()
+
+
+def test_breaker_failed_probe_reopens_for_full_cooldown():
+    clk = FakeClock()
+    br = CircuitBreaker("test.br2", threshold=1, cooldown=10.0,
+                        clock=clk, register=False)
+    br.record_failure()
+    clk.now += 11.0
+    assert br.allow()           # the probe
+    br.record_failure()         # probe fails
+    assert br.state == "open"
+    clk.now += 9.0
+    assert not br.allow(), "failed probe restarts the cooldown"
+    clk.now += 2.0
+    assert br.allow()
+
+
+def test_breaker_success_resets_consecutive_failures():
+    br = CircuitBreaker("test.br3", threshold=2, register=False)
+    br.record_failure()
+    br.record_success()
+    br.record_failure()
+    assert br.state == "closed", "non-consecutive failures don't open"
+
+
+def test_breaker_context_manager_and_metrics():
+    clk = FakeClock()
+    # registered: only registered breakers own (and write) the state
+    # gauge; unregistered shared-label ones would clobber each other
+    br = CircuitBreaker("test.br4", threshold=1, cooldown=5.0,
+                        clock=clk, register=True, label="test.br4")
+    with pytest.raises(RuntimeError):
+        with br:
+            raise RuntimeError("boom")
+    assert br.state == "open"
+    with pytest.raises(BreakerOpen):
+        with br:
+            pass
+    assert REGISTRY.sample("resilience_breaker_state",
+                           {"breaker": "test.br4"}) == 2
+    clk.now += 6.0
+    with br:
+        pass                    # successful probe
+    assert br.state == "closed"
+    assert REGISTRY.sample("resilience_breaker_state",
+                           {"breaker": "test.br4"}) == 0
+    snap = br.snapshot()
+    assert snap["state"] == "closed" and snap["threshold"] == 1
+    from pybitmessage_tpu.resilience import BREAKERS
+    BREAKERS.pop("test.br4", None)
+
+
+# ---------------------------------------------------------------------------
+# stall guard
+# ---------------------------------------------------------------------------
+
+
+def test_stall_guard_passes_results_and_exceptions_through():
+    g = StallGuard(timeout=5.0, site="test.guard")
+    assert g.run(lambda: 42) == 42
+    with pytest.raises(KeyError):
+        g.run(lambda: (_ for _ in ()).throw(KeyError("k")))
+
+
+def test_stall_guard_detects_stall_and_counts():
+    before = REGISTRY.sample("pow_stall_total", {"site": "test.stall"})
+    g = StallGuard(timeout=0.05, site="test.stall")
+    with pytest.raises(SlabStallError):
+        g.run(lambda: time.sleep(1.0))
+    assert REGISTRY.sample("pow_stall_total",
+                           {"site": "test.stall"}) == before + 1
+
+
+def test_stall_guard_disabled_runs_inline():
+    g = StallGuard(timeout=0.0, site="test.off")
+    assert g.run(lambda: "inline") == "inline"
+
+
+# ---------------------------------------------------------------------------
+# PoW journal
+# ---------------------------------------------------------------------------
+
+
+def test_journal_add_checkpoint_complete_cycle():
+    j = PowJournal()
+    jid, start = j.add(IH, 2**40)
+    assert start == 0
+    j.mark_inflight(jid)
+    j.checkpoint(jid, 1 << 20)
+    # monotonic: a stale smaller offset never rolls back
+    j.checkpoint(jid, 1 << 10)
+    assert j.get(jid).start_nonce == 1 << 20
+    # re-adding the same (ih, target) adopts the row + checkpoint
+    jid2, start2 = j.add(IH, 2**40)
+    assert (jid2, start2) == (jid, 1 << 20)
+    j.complete(jid)
+    assert j.pending_count() == 0
+    j.close()
+
+
+def test_journal_survives_reopen_with_inflight_adoption(tmp_path):
+    path = str(tmp_path / "powjournal.dat")
+    j = PowJournal(path)
+    jid, _ = j.add(IH, 2**42)
+    j.mark_inflight(jid)
+    j.checkpoint(jid, 777 * 4096)
+    j.close()                    # simulated crash point
+
+    j2 = PowJournal(path)
+    jobs = j2.pending()
+    assert len(jobs) == 1
+    job = jobs[0]
+    assert job.status == "queued", "inflight rows re-queue at open"
+    assert job.initial_hash == IH and job.target == 2**42
+    assert job.start_nonce == 777 * 4096
+    # the resumed solve adopts the checkpoint through the normal add()
+    jid3, start3 = j2.add(IH, 2**42)
+    assert start3 == 777 * 4096
+    j2.close()
+
+
+def test_journal_purges_stale_rows(tmp_path):
+    path = str(tmp_path / "powjournal.dat")
+    j = PowJournal(path)
+    j.add(IH, 99)
+    # age the row beyond the purge horizon
+    j._conn.execute("UPDATE powjobs SET enqueued_at = enqueued_at - ?",
+                    (30 * 24 * 3600,))
+    j.close()
+    j2 = PowJournal(path)
+    assert j2.pending_count() == 0
+    j2.close()
+
+
+# ---------------------------------------------------------------------------
+# chaos registry
+# ---------------------------------------------------------------------------
+
+
+def test_chaos_deterministic_under_seed():
+    def fire_pattern(seed):
+        reg = ChaosRegistry(seed=seed)
+        reg.arm("x.site", probability=0.5)
+        out = []
+        for _ in range(64):
+            try:
+                reg.inject("x.site")
+                out.append(0)
+            except ChaosError:
+                out.append(1)
+        return out
+
+    assert fire_pattern(7) == fire_pattern(7)
+    assert fire_pattern(7) != fire_pattern(8), \
+        "different seeds should differ (64 draws)"
+
+
+def test_chaos_count_cap_and_disarm():
+    reg = ChaosRegistry()
+    reg.arm("y.site", probability=1.0, count=2)
+    fired = 0
+    for _ in range(5):
+        try:
+            reg.inject("y.site")
+        except ChaosError:
+            fired += 1
+    assert fired == 2
+    assert reg.active()["y.site"]["fired"] == 2
+    reg.disarm("y.site")
+    reg.inject("y.site")        # disarmed: no-op
+
+
+def test_chaos_env_spec_parsing():
+    reg = ChaosRegistry()
+    reg.configure("a.b:0.25, c.d:1x3 ,net.send", seed=5)
+    active = reg.active()
+    assert active["a.b"]["probability"] == 0.25
+    assert active["c.d"] == {"probability": 1.0, "count": 3, "fired": 0,
+                             "delay": 0.0}
+    assert active["net.send"]["probability"] == 1.0
+    # net.* sites default to connection-style exceptions
+    with pytest.raises(ConnectionError):
+        reg.inject("net.send")
+
+
+# ---------------------------------------------------------------------------
+# dispatcher integration: breakers replace the permanent latch
+# ---------------------------------------------------------------------------
+
+
+def test_dispatcher_tpu_breaker_opens_and_recovers_via_half_open():
+    """The acceptance-criteria loop: a repeatedly failing tier opens
+    its breaker (fallbacks stop paying the failure latency), then a
+    half-open probe after cooldown restores it."""
+    from pybitmessage_tpu.pow import PowDispatcher
+
+    clk = FakeClock()
+    d = PowDispatcher(use_native=False,
+                      tpu_kwargs={"lanes": 256, "chunks_per_call": 8},
+                      breakers={
+                          "tpu": CircuitBreaker(
+                              "t.tpu", threshold=1, cooldown=30.0,
+                              clock=clk, register=False),
+                          "tpu-pallas": CircuitBreaker(
+                              "t.pallas", threshold=1, cooldown=30.0,
+                              clock=clk, register=False),
+                          "cpp": CircuitBreaker(
+                              "t.cpp", register=False),
+                      })
+    CHAOS.disarm()
+    CHAOS.arm("pow.device_launch", probability=1.0)
+    try:
+        nonce, _ = d.solve(IH, 2**58)
+        # fault at the device tier: ladder rescued the solve on python
+        assert d.last_backend == "python"
+        assert d.breakers["tpu"].state == "open"
+        assert "tpu" not in d.backends()
+
+        # while open, the dead tier is not retried at all
+        attempts_before = REGISTRY.sample("pow_attempts_total",
+                                          {"backend": "tpu-sharded"})
+        d.solve(IH, 2**58)
+        assert d.last_backend == "python"
+        assert REGISTRY.sample(
+            "pow_attempts_total",
+            {"backend": "tpu-sharded"}) == attempts_before
+    finally:
+        CHAOS.disarm()
+
+    # cooldown elapses, the fault is gone: half-open probe recovers
+    clk.now += 31.0
+    nonce, _ = d.solve(IH, 2**58)
+    assert d.last_backend == "tpu-sharded"
+    assert d.breakers["tpu"].state == "closed"
+    assert "tpu" in d.backends()
+    from pybitmessage_tpu.pow.dispatcher import host_trial
+    assert host_trial(nonce, IH) <= 2**58
+
+
+def test_dispatcher_interrupt_releases_half_open_probe():
+    """A shutdown interrupt during the half-open probe must not wedge
+    the breaker in probe-in-flight (which would block recovery)."""
+    br = CircuitBreaker("t.probe", threshold=1, cooldown=0.0,
+                        register=False)
+    br.record_failure()
+    assert br.allow()            # consume the probe slot
+    br.release_probe()
+    assert br.allow(), "released probe slot must be claimable again"
+
+
+# ---------------------------------------------------------------------------
+# PowService: requeue on failure, journal lifecycle
+# ---------------------------------------------------------------------------
+
+
+class FlakyDispatcher:
+    """Fails the first ``fail_times`` batches, then solves instantly."""
+
+    last_backend = "flaky"
+
+    def __init__(self, fail_times):
+        self.fail_times = fail_times
+        self.calls = 0
+        self.seen_starts = []
+
+    def solve_batch(self, items, should_stop=None, start_nonces=None,
+                    progress=None):
+        self.calls += 1
+        self.seen_starts.append(list(start_nonces or []))
+        if self.calls <= self.fail_times:
+            raise RuntimeError("transient tier failure %d" % self.calls)
+        return [(7, 1)] * len(items)
+
+
+@pytest.mark.asyncio
+async def test_service_requeues_failed_batch_instead_of_dropping():
+    from pybitmessage_tpu.pow.service import PowService
+
+    disp = FlakyDispatcher(fail_times=2)
+    svc = PowService(disp, window=0.01, max_attempts=3,
+                     retry=RetryPolicy(attempts=3, base_delay=0.01,
+                                       jitter=0.0))
+    svc.start()
+    try:
+        before = REGISTRY.sample("pow_requeue_total",
+                                 {"reason": "failure"})
+        result = await asyncio.wait_for(svc.solve(IH, 2**60), timeout=10)
+        assert result == (7, 1), \
+            "a transient failure must not lose the queued object"
+        assert disp.calls == 3
+        assert REGISTRY.sample("pow_requeue_total",
+                               {"reason": "failure"}) >= before + 2
+    finally:
+        await svc.stop()
+
+
+@pytest.mark.asyncio
+async def test_service_surfaces_error_after_max_attempts_but_keeps_journal():
+    from pybitmessage_tpu.pow.service import PowService
+
+    journal = PowJournal()
+    disp = FlakyDispatcher(fail_times=99)
+    svc = PowService(disp, window=0.01, max_attempts=2, journal=journal,
+                     retry=RetryPolicy(attempts=2, base_delay=0.01,
+                                       jitter=0.0))
+    svc.start()
+    try:
+        with pytest.raises(RuntimeError, match="transient tier failure"):
+            await asyncio.wait_for(svc.solve(IH, 2**60), timeout=10)
+        assert disp.calls == 2
+        # the job STAYS journaled for the next process
+        assert journal.pending_count() == 1
+        assert journal.pending()[0].status == "queued"
+    finally:
+        await svc.stop()
+        journal.close()
+
+
+@pytest.mark.asyncio
+async def test_service_journal_completes_on_success():
+    from pybitmessage_tpu.pow.service import PowService
+
+    journal = PowJournal()
+    disp = FlakyDispatcher(fail_times=0)
+    svc = PowService(disp, window=0.01, journal=journal)
+    svc.start()
+    try:
+        await asyncio.wait_for(svc.solve(IH, 2**60), timeout=10)
+        assert journal.pending_count() == 0, \
+            "completed jobs must leave the journal"
+    finally:
+        await svc.stop()
+        journal.close()
