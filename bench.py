@@ -604,6 +604,189 @@ def _bench_degraded_fallback(n: int = 4, target_exp: int = 56) -> dict:
     }
 
 
+# -- ingest fast path (ISSUE 4) ----------------------------------------------
+
+def _ingest_stage_stats() -> dict:
+    """Per-stage ingest latency percentiles from the registry."""
+    fam = REGISTRY.get("ingest_stage_seconds")
+    out = {}
+    if fam is None:
+        return out
+    for values, child in fam.children():
+        if child.count:
+            out[values[0]] = {
+                "count": child.count,
+                "p50_us": round(child.percentile(0.50) * 1e6, 1),
+                "p90_us": round(child.percentile(0.90) * 1e6, 1),
+            }
+    return out
+
+
+def _bench_ingest_storm(identities: int = 8, objects: int = 400,
+                        smoke: bool = False) -> dict:
+    """Ingest fast path end-to-end: a multi-identity flood mix (msgs
+    for us spread over N identities, plus msgs for nobody that force
+    the full trial-decrypt miss sweep) pushed through ObjectProcessor,
+    socket-side to store.
+
+    Measured BOTH ways:
+
+    - ``pipelined``: the fast path — crypto-pool fan-out with
+      first-match early-cancel, cached parsed keys, write-behind
+      storage, 8 concurrent pipeline workers;
+    - ``inline``: the pre-PR path — one worker, inline crypto on the
+      event loop, per-row autocommit, parsed-key cache disabled.
+
+    A 5 ms loop-lag probe rides along both runs; in full (non-smoke)
+    mode the pipelined run asserts the event loop was never blocked
+    > 50 ms by crypto or SQL.  The inline run's lag is reported as the
+    contrast figure.
+    """
+    import asyncio
+
+    from pybitmessage_tpu.crypto import encrypt, priv_to_pub, sign
+    from pybitmessage_tpu.crypto.keys import (random_private_key,
+                                              set_key_cache)
+    from pybitmessage_tpu.models import msgcoding
+    from pybitmessage_tpu.models.constants import OBJECT_MSG
+    from pybitmessage_tpu.models.payloads import (MsgPlaintext,
+                                                  get_bitfield,
+                                                  object_shell)
+    from pybitmessage_tpu.models.pow_math import pow_target
+    from pybitmessage_tpu.pow.dispatcher import python_solve
+    from pybitmessage_tpu.storage.db import Database
+    from pybitmessage_tpu.storage.messages import MessageStore
+    from pybitmessage_tpu.utils.hashes import sha512 as _sha512
+    from pybitmessage_tpu.workers.cryptopool import CryptoPool
+    from pybitmessage_tpu.workers.keystore import KeyStore
+    from pybitmessage_tpu.workers.processor import ObjectProcessor
+
+    ks = KeyStore()
+    idents = [ks.create_random("flood %d" % i) for i in range(identities)]
+    for ident in idents:
+        # trivial demanded difficulty: the bench measures ingest, and
+        # flood objects carry matching trivial PoW (test-mode analog)
+        ident.nonce_trials_per_byte = 1
+        ident.extra_bytes = 1
+    sender_ident = idents[0]
+    foreign_pub = priv_to_pub(random_private_key())
+    ttl = 3600
+    expires = int(time.time()) + ttl
+    shell = object_shell(expires, OBJECT_MSG, 1, 1)
+
+    def build(i: int, recipient_pub, dest_ripe: bytes) -> bytes:
+        body = msgcoding.encode_message("storm %d" % i,
+                                        "ingest bench body %d" % i)
+        plain = MsgPlaintext(
+            sender_version=sender_ident.version, sender_stream=1,
+            bitfield=get_bitfield(False),
+            pub_signing_key=sender_ident.pub_signing_key,
+            pub_encryption_key=sender_ident.pub_encryption_key,
+            nonce_trials_per_byte=1, extra_bytes=1,
+            dest_ripe=dest_ripe, encoding=2, message=body, ack_data=b"")
+        plain.signature = sign(shell + plain.encode_unsigned(),
+                               sender_ident.priv_signing)
+        sans_nonce = shell + encrypt(plain.encode(), recipient_pub)
+        target = pow_target(len(sans_nonce) + 8, ttl, 1, 1, clamp=False)
+        nonce, _ = python_solve(_sha512(sans_nonce), target)
+        return nonce.to_bytes(8, "big") + sans_nonce
+
+    payloads, for_us = [], 0
+    for i in range(objects):
+        if i % 4 == 3:          # 25% decrypt-all-miss traffic
+            payloads.append(build(i, foreign_pub, b"\x00" * 20))
+        else:
+            r = idents[i % identities]
+            payloads.append(build(i, r.pub_encryption_key, r.ripe))
+            for_us += 1
+
+    class _StubSender:
+        def __init__(self):
+            self.watched_acks = set()
+            self.needed_pubkeys = {}
+            self.queue = asyncio.Queue()
+
+    async def run(pipelined: bool) -> dict:
+        db = Database()
+        store = MessageStore(db)
+        proc = ObjectProcessor(
+            keystore=ks, store=store, inventory=None,
+            sender=_StubSender(), min_ntpb=1, min_extra=1,
+            crypto=CryptoPool() if pipelined else CryptoPool(size=0),
+            concurrency=8 if pipelined else 1,
+            write_behind=pipelined)
+        lag = {"max": 0.0}
+        done = asyncio.Event()
+
+        async def probe():
+            loop = asyncio.get_running_loop()
+            while not done.is_set():
+                t0 = loop.time()
+                await asyncio.sleep(0.005)
+                lag["max"] = max(lag["max"], loop.time() - t0 - 0.005)
+
+        prober = asyncio.create_task(probe())
+        proc.start()
+        t0 = time.perf_counter()
+        for p in payloads:
+            await proc.queue.put(p)
+        while proc.pending():
+            await asyncio.sleep(0.002)
+        await proc.stop()       # final write-behind drain is in-scope
+        dt = max(time.perf_counter() - t0, 1e-9)
+        done.set()
+        await prober
+        delivered = len(store.inbox())
+        db.close()
+        return {
+            "wall_s": round(dt, 3),
+            "objects_per_s": round(len(payloads) / dt, 1),
+            "delivered": delivered,
+            "max_loop_lag_ms": round(lag["max"] * 1e3, 2),
+        }
+
+    pipe = asyncio.run(run(True))
+    set_key_cache(False)        # honest pre-PR baseline: no key cache
+    try:
+        inline = asyncio.run(run(False))
+    finally:
+        set_key_cache(True)
+    assert pipe["delivered"] == for_us, (
+        "pipelined run delivered %d of %d" % (pipe["delivered"], for_us))
+    assert inline["delivered"] == for_us, (
+        "inline run delivered %d of %d" % (inline["delivered"], for_us))
+    if not smoke:
+        # acceptance: the event loop is never blocked > 50 ms by
+        # crypto or SQL on the fast path
+        assert pipe["max_loop_lag_ms"] < 50.0, (
+            "event loop blocked %.1f ms" % pipe["max_loop_lag_ms"])
+    return {
+        "objects": objects, "identities": identities,
+        "mix": {"for_us": for_us, "foreign": objects - for_us},
+        "pipelined": pipe, "inline_baseline": inline,
+        "speedup_vs_inline": round(
+            pipe["objects_per_s"] / max(inline["objects_per_s"], 1e-9), 2),
+        "decrypt_fanout_p50": round(
+            (REGISTRY.get("crypto_decrypt_fanout_size") or
+             _NullHist()).percentile(0.5), 1),
+        "stage_latency": _ingest_stage_stats(),
+        "write_behind": {
+            "flushes": int(REGISTRY.sample(
+                "storage_write_behind_flushes_total", {"result": "ok"})),
+            "rows_per_flush_p90": round(
+                (REGISTRY.get("storage_write_behind_flush_size") or
+                 _NullHist()).percentile(0.9), 1),
+        },
+    }
+
+
+class _NullHist:
+    count = 0
+
+    def percentile(self, q):
+        return 0.0
+
+
 def _smoke_main() -> int:
     """Tiny CPU-only bench for CI (``make bench-smoke``): reduced
     slabs, reference test-mode difficulty, XLA impl — exercises the
@@ -683,6 +866,15 @@ def _smoke_main() -> int:
         configs["degraded_fallback"] = _bench_degraded_fallback()
     except Exception as exc:
         configs["degraded_fallback"] = {"error": repr(exc)[:200]}
+    # ingest fast path: tiny flood mix through the pipelined
+    # processor vs the inline path (no lag assertion in smoke mode)
+    try:
+        configs["ingest_storm"] = _bench_ingest_storm(
+            identities=3, objects=36, smoke=True)
+    except ImportError as exc:  # optional `cryptography` absent
+        configs["ingest_storm"] = {"skipped": repr(exc)[:120]}
+    except Exception as exc:
+        configs["ingest_storm"] = {"error": repr(exc)[:200]}
     print(json.dumps({
         "metric": "double_sha512_trial_hashes_per_sec_per_chip",
         "value": round(device, 1),
@@ -746,6 +938,18 @@ def main():
         configs["degraded_fallback"] = _bench_degraded_fallback()
     except Exception as exc:
         configs["degraded_fallback"] = {"error": repr(exc)[:200]}
+    # ingest fast path (ISSUE 4): host-side end-to-end objects/s on a
+    # multi-identity flood mix vs the pre-PR inline path, with the
+    # loop-lag acceptance probe (<50 ms) armed — an AssertionError
+    # here must fail the bench, not hide in the JSON
+    try:
+        configs["ingest_storm"] = _bench_ingest_storm()
+    except AssertionError:
+        raise
+    except ImportError as exc:  # optional `cryptography` absent
+        configs["ingest_storm"] = {"skipped": repr(exc)[:120]}
+    except Exception as exc:
+        configs["ingest_storm"] = {"error": repr(exc)[:200]}
     # measured MFU from a profiler trace (device-side kernel time);
     # the wall-clock u32_ops_per_sec stays alongside for continuity
     mfu_info = None

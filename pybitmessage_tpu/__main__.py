@@ -118,6 +118,15 @@ async def run(args) -> int:
     node.settings = settings
     node.dandelion.stem_probability = settings.getint("dandelion")
     node.processor.list_mode = settings.get("blackwhitelist")
+    # ingest fast path knobs (docs/ingest.md) — applied before start()
+    # spawns the pipeline workers
+    node.processor.concurrency = settings.getint("ingestworkers")
+    if settings.getint("cryptoworkers"):
+        node.processor.crypto.size = settings.getint("cryptoworkers")
+    queue = node.ctx.object_queue
+    if hasattr(queue, "high"):
+        queue.high = settings.getint("ingestqueuehigh")
+        queue.low = max(1, queue.high // 4)
     # kB/s global throttles (reference maxdownloadrate/maxuploadrate)
     node.ctx.download_bucket.rate = settings.getint("maxdownloadrate") * 1024
     node.ctx.upload_bucket.rate = settings.getint("maxuploadrate") * 1024

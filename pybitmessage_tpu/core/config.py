@@ -79,6 +79,14 @@ DEFAULTS: dict[str, str] = {
     "powchunks": "32",               # chunks per jitted call
     "powbatchwindow": "0.05",        # PoW coalescing window, seconds
                                      # (0 = launch immediately)
+    # -- ingest fast path (docs/ingest.md) --
+    "ingestworkers": "8",            # concurrent objects in the
+                                     # processor pipeline
+    "cryptoworkers": "0",            # crypto pool threads (0 = auto:
+                                     # min(8, cores))
+    "ingestqueuehigh": "512",        # object-queue high watermark
+                                     # pausing connection reads
+                                     # (0 = never pause)
     # -- resilience (docs/resilience.md) --
     "powstalltimeout": "120",        # per-harvest slab stall deadline,
                                      # seconds (0 = watchdog off)
@@ -143,6 +151,9 @@ VALIDATORS: dict[str, Callable[[str], bool]] = {
     "powlanes": _validate_int_range(128, 1 << 24),
     "powchunks": _validate_int_range(1, 4096),
     "powbatchwindow": _validate_float_range(0.0, 10.0),
+    "ingestworkers": _validate_int_range(1, 256),
+    "cryptoworkers": _validate_int_range(0, 256),
+    "ingestqueuehigh": _validate_int_range(0, 1 << 20),
     "powstalltimeout": _validate_float_range(0.0, 86400.0),
     "powmaxretries": _validate_int_range(1, 100),
     "breakerfailures": _validate_int_range(1, 1000),

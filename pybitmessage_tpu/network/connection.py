@@ -160,8 +160,8 @@ class BMConnection:
         # fully received, and cancelling would strand their hashes in
         # GlobalTracker.missing for an hour (no peer re-requests a
         # hash marked in flight).  They settle within one verifier
-        # round; node shutdown resolves them by cancelling the
-        # verifier's futures instead.
+        # round; node shutdown settles them deterministically as
+        # unverified (BatchVerifier.stop sets False, never cancels).
         if self._handshake_task is not None and \
                 not self._handshake_task.done() and \
                 self._handshake_task is not asyncio.current_task():
@@ -210,6 +210,13 @@ class BMConnection:
         return chunks[0] if len(chunks) == 1 else b"".join(chunks)
 
     async def _read_packet(self) -> None:
+        # ingest backpressure (docs/ingest.md): while the validated-
+        # object queue sits above its high watermark, stop reading —
+        # the kernel buffer fills and TCP flow control pushes the
+        # flood back onto the peers instead of into our memory
+        wait_resume = getattr(self.ctx.object_queue, "wait_resume", None)
+        if wait_resume is not None:
+            await wait_resume()
         header = await self._read_throttled(HEADER_LEN)
         # resync on bad magic: scan forward byte-at-a-time
         # (reference bmproto.py:85-98)

@@ -84,7 +84,9 @@ class NodeContext:
                  nonce: bytes | None = None,
                  allow_private_peers: bool = False,
                  pow_ntpb: int = 1000, pow_extra: int = 1000,
-                 announce_buckets: int | None = None):
+                 announce_buckets: int | None = None,
+                 ingest_high: int | None = None,
+                 ingest_low: int | None = None):
         self.inventory = inventory
         self.knownnodes = knownnodes
         self.dandelion = dandelion
@@ -106,8 +108,16 @@ class NodeContext:
         self.download_bucket = TokenBucket(0, direction="rx")
         self.upload_bucket = TokenBucket(0, direction="tx")
         self.global_tracker = GlobalTracker()
-        #: validated objects flow out here: (hash, header, payload)
-        self.object_queue: asyncio.Queue = asyncio.Queue()
+        #: validated objects flow out here: (hash, header, payload).
+        #: Watermarked (docs/ingest.md): crossing HIGH pauses every
+        #: connection's read loop until the processor drains it back
+        #: under LOW — a flood stalls sockets, not memory (the old
+        #: plain Queue grew without bound)
+        from ..utils.queues import DEFAULT_HIGH_WATERMARK, WatermarkQueue
+        self.object_queue: asyncio.Queue = WatermarkQueue(
+            high=DEFAULT_HIGH_WATERMARK if ingest_high is None
+            else ingest_high,
+            low=ingest_low)
         #: optional BatchVerifier — incoming objects' PoW checked in
         #: fused device batches instead of one host hash pair each
         self.pow_verifier = None

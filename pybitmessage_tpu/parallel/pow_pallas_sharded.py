@@ -256,13 +256,16 @@ def pallas_sharded_solve(initial_hash: bytes, target: int, mesh: Mesh, *,
                          unroll: int = DEFAULT_UNROLL,
                          impl: str | None = None, interpret: bool = False,
                          variant: str = DEFAULT_VARIANT,
-                         should_stop: Callable[[], bool] | None = None):
+                         should_stop: Callable[[], bool] | None = None,
+                         progress: Callable[[int], None] | None = None):
     """Pod-wide solve running the production Pallas kernel per chip.
 
     Same contract as ``ops.solve`` / ``sha512_pallas.solve``: returns
     ``(nonce, trials)`` or raises ``PowInterrupted``.  Double-buffered
     host loop (one pod slab in flight ahead of the harvest) with
-    stride ``ndev * rows*128*chunks`` per call.
+    stride ``ndev * rows*128*chunks`` per call.  ``progress(next)``
+    checkpoints resumable search state whenever a pod slab harvests
+    miss-free (same contract as ``sha512_pallas.solve``).
     """
     import numpy as np
 
@@ -293,22 +296,27 @@ def pallas_sharded_solve(initial_hash: bytes, target: int, mesh: Mesh, *,
 
     base = start_nonce & _MASK64
     trials = 0
-    pending = None
+    pending = None      # (device_out, end_base of that slab)
     while True:
         if should_stop is not None and should_stop():
             if pending is not None:
                 trials += stride
-                nonce = harvest(pending)
+                nonce = harvest(pending[0])
                 if nonce is not None:
                     return nonce, trials
+                if progress is not None:
+                    progress(pending[1])
             raise PowInterrupted("sharded Pallas PoW interrupted")
-        current = fn(ih_words, _pair_arr(base), target_arr)
-        base = (base + stride) & _MASK64
+        end_base = (base + stride) & _MASK64
+        current = (fn(ih_words, _pair_arr(base), target_arr), end_base)
+        base = end_base
         if pending is not None:
             trials += stride
-            nonce = harvest(pending)
+            nonce = harvest(pending[0])
             if nonce is not None:
                 return nonce, trials
+            if progress is not None:
+                progress(pending[1])
         pending = current
 
 
@@ -326,7 +334,8 @@ def pallas_sharded_solve_batch(items, mesh: Mesh, *,
                                impl: str | None = None,
                                interpret: bool = False,
                                variant: str = DEFAULT_VARIANT,
-                               should_stop: Callable[[], bool] | None = None):
+                               should_stop: Callable[[], bool] | None = None,
+                               start_nonces=None, progress=None):
     """Solve ``[(initial_hash, target), ...]`` pod-wide, Pallas per chip.
 
     2D (obj x nonce) mesh: objects data-parallel, nonce ranges
@@ -339,6 +348,16 @@ def pallas_sharded_solve_batch(items, mesh: Mesh, *,
     configuration compiled + verified on real hardware, independent of
     the single kernel's unroll knee).  Returns ``[(nonce, trials),
     ...]`` aligned with ``items``.
+
+    Resumable-PoW hooks (resilience/journal.py): ``start_nonces``
+    gives one journaled offset per item — each object's device-
+    resident range partition starts THERE instead of 0, so a restarted
+    pod solve no longer re-searches work a previous process already
+    covered.  ``progress(i, next_nonce)`` fires as slabs harvest
+    miss-free with the end of item ``i``'s fully-searched range (the
+    same checkpoint contract as the single-chip pipeline: every nonce
+    in ``[start_nonces[i], next_nonce)`` has been searched without a
+    hit).
     """
     import numpy as np
 
@@ -349,13 +368,21 @@ def pallas_sharded_solve_batch(items, mesh: Mesh, *,
         return []
     if impl is None:
         impl = default_impl()
+    starts = list(start_nonces) if start_nonces else [0] * n
     if len(mesh.axis_names) < 2:
-        return [pallas_sharded_solve(ih, t, mesh, rows=rows,
-                                     chunks_per_call=chunks_per_call,
-                                     unroll=unroll, impl=impl,
-                                     interpret=interpret, variant=variant,
-                                     should_stop=should_stop)
-                for ih, t in items]
+        out = []
+        for i, (ih, t) in enumerate(items):
+            prog = None
+            if progress is not None:
+                prog = (lambda nxt, _i=i: progress(_i, nxt))
+            out.append(pallas_sharded_solve(
+                ih, t, mesh, rows=rows,
+                chunks_per_call=chunks_per_call,
+                unroll=unroll, impl=impl,
+                interpret=interpret, variant=variant,
+                start_nonce=starts[i], progress=prog,
+                should_stop=should_stop))
+        return out
 
     obj_size = mesh.shape[mesh.axis_names[0]]
     nonce_devs = mesh.shape[mesh.axis_names[-1]]
@@ -382,7 +409,11 @@ def pallas_sharded_solve_batch(items, mesh: Mesh, *,
         # one
         step_trials = rows * LANE_COLS * (
             unroll if impl == "pallas" else 1)
-        bases = [0] * group_objs
+        # journaled resume offsets (ISSUE 4 satellite, closing the
+        # ROADMAP known gap): each object's device-resident range
+        # partition starts at its checkpoint instead of 0
+        bases = [starts[start + i] & _MASK64 if i < len(group) else 0
+                 for i in range(group_objs)]
         trials = [0] * group_objs
         done = [i >= len(group) for i in range(group_objs)]
 
@@ -399,9 +430,12 @@ def pallas_sharded_solve_batch(items, mesh: Mesh, *,
             out = fn(ih_words, b_arr, t_arr)
             for i in live:
                 bases[i] = (bases[i] + stride) & _MASK64
-            return out, live
+            # per-slab end bases: the checkpoint each live object may
+            # report once THIS slab harvests miss-free (bases keeps
+            # advancing under dispatch-ahead, so snapshot now)
+            return out, live, {i: bases[i] for i in live}
 
-        def harvest(out_dev, live):
+        def harvest(out_dev, live, end_bases):
             nonlocal t_arr
             t0 = _time.monotonic()
             packed = np.asarray(out_dev)          # the blocking fetch
@@ -431,6 +465,10 @@ def pallas_sharded_solve_batch(items, mesh: Mesh, *,
                         jnp.array([0xFFFFFFFF, 0xFFFFFFFF], dtype=U32))
                 else:
                     trials[i] += stride
+                    if progress is not None:
+                        # this object's slab harvested miss-free —
+                        # everything below its end base is searched
+                        progress(start + i, end_bases[i])
 
         import time as _time
 

@@ -253,9 +253,10 @@ class PowDispatcher:
         resumes each object's search from a journaled checkpoint, and
         ``progress(i, next_nonce)`` is called as slabs harvest with
         the highest offset known fully searched for item ``i`` — the
-        pipelined single-chip path and the sequential ladder honor
-        both; the pod-sharded batch kernels re-search from 0 (their
-        range partition is device-resident) but remain correct.
+        pipelined single-chip path, the pod-sharded Pallas batch loop
+        and the sequential ladder all honor both (the XLA
+        ``sharded_solve_batch`` rescue tier still re-searches from 0
+        but remains correct).
         """
         items = list(items)
         if not items:
@@ -277,7 +278,8 @@ class PowDispatcher:
                             ATTEMPTS.labels(backend=self.last_backend).inc()
                             results = pallas_sharded_solve_batch(
                                 items, self._mesh(ndev, len(items)),
-                                should_stop=should_stop)
+                                should_stop=should_stop,
+                                start_nonces=starts, progress=progress)
                             pb.record_success()
                             tb.record_success()
                         except PowInterrupted:
@@ -432,7 +434,8 @@ class PowDispatcher:
                             result = pallas_sharded_solve(
                                 initial_hash, target, self._mesh(ndev, 1),
                                 start_nonce=start_nonce,
-                                should_stop=should_stop)
+                                should_stop=should_stop,
+                                progress=progress)
                             pb.record_success()
                             tb.record_success()
                             return result

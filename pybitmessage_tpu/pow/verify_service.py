@@ -37,6 +37,11 @@ VERIFY_BATCH_SIZE = REGISTRY.histogram(
 VERIFY_REJECTED = REGISTRY.counter(
     "pow_verify_rejected_total",
     "Incoming objects whose embedded PoW failed the target")
+VERIFY_SHUTDOWN = REGISTRY.counter(
+    "pow_verify_shutdown_unverified_total",
+    "Checks still pending at verifier shutdown, settled as unverified "
+    "(False) instead of leaking CancelledError into per-connection "
+    "verification tasks")
 
 
 class BatchVerifier:
@@ -72,12 +77,21 @@ class BatchVerifier:
                 await self._task
             except asyncio.CancelledError:
                 pass
-        # settle any still-queued checks so pipelined per-connection
-        # verification tasks waiting on them finish instead of hanging
+        # settle any still-queued checks DETERMINISTICALLY: a pending
+        # future resolves to False (reject-as-unverified, counted)
+        # rather than being cancelled — cancellation leaked
+        # CancelledError into the per-connection verification tasks,
+        # which surfaced as spurious "object acceptance failed" noise
+        # at every shutdown
         while not self.queue.empty():
             _, fut = self.queue.get_nowait()
-            if not fut.done():
-                fut.cancel()
+            self._settle_unverified(fut)
+
+    @staticmethod
+    def _settle_unverified(fut: asyncio.Future) -> None:
+        if not fut.done():
+            VERIFY_SHUTDOWN.inc()
+            fut.set_result(False)
 
     async def check(self, object_bytes: bytes) -> bool:
         """True when the object's embedded PoW meets the target."""
@@ -104,36 +118,44 @@ class BatchVerifier:
                 batch.append(await self.queue.get())
                 if self.window > 0:
                     await asyncio.sleep(self.window)
-            except asyncio.CancelledError:
-                for _, fut in batch:
+                while not self.queue.empty():
+                    batch.append(self.queue.get_nowait())
+                results = None
+                VERIFY_BATCH_SIZE.observe(len(batch))
+                if self.use_device and \
+                        len(batch) >= self.min_device_batch:
+                    try:
+                        results = await self._device_verify(
+                            [ob for ob, _ in batch])
+                        self.device_checked += len(batch)
+                        self.device_batches += 1
+                        VERIFIED.labels(path="device").inc(len(batch))
+                        VERIFY_BATCHES.inc()
+                    except asyncio.CancelledError:
+                        raise
+                    except Exception:
+                        from ..resilience.policy import ERRORS
+                        ERRORS.labels(site="pow.verify_device").inc()
+                        logger.exception(
+                            "device PoW verification failed; host "
+                            "fallback")
+                if results is None:
+                    results = [self._host_check(ob) for ob, _ in batch]
+                    self.host_checked += len(batch)
+                    VERIFIED.labels(path="host").inc(len(batch))
+                VERIFY_REJECTED.inc(sum(1 for ok in results if not ok))
+                for (_, fut), ok in zip(batch, results):
                     if not fut.done():
-                        fut.cancel()
+                        fut.set_result(bool(ok))
+            except asyncio.CancelledError:
+                # deterministic settlement for EVERY popped member —
+                # cancellation can land at any await above (queue,
+                # window sleep, or mid device batch), and a popped
+                # future left pending would hang its per-connection
+                # verification task forever
+                for _, fut in batch:
+                    self._settle_unverified(fut)
                 raise
-            while not self.queue.empty():
-                batch.append(self.queue.get_nowait())
-            results = None
-            VERIFY_BATCH_SIZE.observe(len(batch))
-            if self.use_device and len(batch) >= self.min_device_batch:
-                try:
-                    results = await self._device_verify(
-                        [ob for ob, _ in batch])
-                    self.device_checked += len(batch)
-                    self.device_batches += 1
-                    VERIFIED.labels(path="device").inc(len(batch))
-                    VERIFY_BATCHES.inc()
-                except Exception:
-                    from ..resilience.policy import ERRORS
-                    ERRORS.labels(site="pow.verify_device").inc()
-                    logger.exception(
-                        "device PoW verification failed; host fallback")
-            if results is None:
-                results = [self._host_check(ob) for ob, _ in batch]
-                self.host_checked += len(batch)
-                VERIFIED.labels(path="host").inc(len(batch))
-            VERIFY_REJECTED.inc(sum(1 for ok in results if not ok))
-            for (_, fut), ok in zip(batch, results):
-                if not fut.done():
-                    fut.set_result(bool(ok))
 
     async def _device_verify(self, objects: list[bytes]) -> list[bool]:
         from ..ops.pow_search import verify

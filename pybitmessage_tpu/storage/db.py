@@ -173,6 +173,37 @@ class Database:
                 self._conn.cursor().executemany(sql, rows)
         self._write_retry(run)
 
+    def execute_batch(
+            self, ops: Sequence[tuple[str, Sequence[Sequence[Any]]]]
+    ) -> None:
+        """Run ``[(sql, rows), ...]`` as ONE transaction (executemany
+        per statement) under the single-writer lock.
+
+        The write-behind drain path: a whole coalescing window's worth
+        of inbox/pubkey/sent-status rows lands in a single fsync
+        instead of one autocommit transaction per row.  Goes through
+        :meth:`_write_retry`, so the ``db.write`` chaos site and the
+        transient-failure backoff cover it; on failure the transaction
+        rolls back atomically — callers keep their rows buffered and
+        retry the next drain.
+        """
+        ops = [(sql, list(rows)) for sql, rows in ops if rows]
+        if not ops:
+            return
+
+        def run():
+            with self._lock:
+                cur = self._conn.cursor()
+                cur.execute("BEGIN")
+                try:
+                    for sql, rows in ops:
+                        cur.executemany(sql, rows)
+                except BaseException:
+                    cur.execute("ROLLBACK")
+                    raise
+                cur.execute("COMMIT")
+        self._write_retry(run)
+
     def query(self, sql: str, params: Sequence[Any] = ()) -> list[tuple]:
         with self._lock:
             cur = self._conn.cursor()

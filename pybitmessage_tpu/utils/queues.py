@@ -1,4 +1,4 @@
-"""Byte-size-bounded asyncio queue.
+"""Byte-size-bounded and watermark-backpressured asyncio queues.
 
 Reference semantics (src/queues.py:14-38): the objectProcessorQueue
 caps *unprocessed payload bytes* at 32 MB and blocks producers — a
@@ -6,13 +6,84 @@ flood of large objects stalls the network readers instead of ballooning
 memory.  This is the asyncio re-expression: ``put`` awaits while the
 buffered byte total is at/over the cap; ``get`` frees budget and wakes
 waiters.
+
+:class:`WatermarkQueue` adds the ingest-path variant (docs/ingest.md):
+``put_nowait`` never fails (a validated object is never dropped), but
+crossing the HIGH watermark clears a resume event that per-connection
+read loops await before their next packet — under flood the sockets
+pause (TCP flow control pushes back on the peers) until the drain side
+works the queue back under the LOW watermark.
 """
 
 from __future__ import annotations
 
 import asyncio
 
+from ..observability import REGISTRY
+
 DEFAULT_MAX_BYTES = 32 * 1024 * 1024
+
+INGEST_DEPTH = REGISTRY.gauge(
+    "ingest_queue_depth",
+    "Validated objects waiting between the network pool and the "
+    "object processor")
+INGEST_PAUSES = REGISTRY.counter(
+    "ingest_pause_total",
+    "Read-loop pauses: the ingest queue crossed its high watermark "
+    "and connection reads stalled until the low watermark")
+
+#: default high/low watermarks for the network object queue — sized in
+#: objects (the byte cap lives one stage later in ByteBoundedQueue)
+DEFAULT_HIGH_WATERMARK = 512
+DEFAULT_LOW_WATERMARK = 128
+
+
+class WatermarkQueue(asyncio.Queue):
+    """Unbounded queue with high/low-watermark read backpressure.
+
+    ``high=0`` disables pausing entirely (plain queue).  Producers that
+    feed from socket read loops call :meth:`wait_resume` before reading
+    more work; consumers just ``get``.
+    """
+
+    def __init__(self, high: int = DEFAULT_HIGH_WATERMARK,
+                 low: int | None = None):
+        super().__init__()
+        if high and low is None:
+            low = max(1, high // 4)
+        self.high = high
+        self.low = low or 0
+        self.paused = False
+        self._resume = asyncio.Event()
+        self._resume.set()
+
+    def _update(self) -> None:
+        size = self.qsize()
+        INGEST_DEPTH.set(size)
+        if not self.high:
+            return
+        if not self.paused and size >= self.high:
+            self.paused = True
+            self._resume.clear()
+            INGEST_PAUSES.inc()
+        elif self.paused and size <= self.low:
+            self.paused = False
+            self._resume.set()
+
+    def put_nowait(self, item) -> None:
+        super().put_nowait(item)
+        self._update()
+
+    def get_nowait(self):
+        item = super().get_nowait()
+        self._update()
+        return item
+
+    async def wait_resume(self) -> None:
+        """Block while the queue sits between its watermarks' pause
+        window; returns immediately when flow is open."""
+        if self.paused:
+            await self._resume.wait()
 
 
 class ByteBoundedQueue(asyncio.Queue):

@@ -12,7 +12,27 @@ PoW runs on TPU through the solver ladder; incoming-object PoW is
 *batch*-verified on device.
 """
 
-from .keystore import KeyStore, OwnIdentity, Subscription  # noqa: F401
-from .sender import SendWorker  # noqa: F401
-from .processor import ObjectProcessor  # noqa: F401
-from .cleaner import Cleaner  # noqa: F401
+# Lazy exports (PEP 562): most worker modules pull the optional
+# `cryptography` dependency through crypto/; resolving on first
+# attribute access keeps dependency-free members (CryptoPool, and the
+# metrics of any module) importable on minimal images.
+_EXPORTS = {
+    "KeyStore": ".keystore", "OwnIdentity": ".keystore",
+    "Subscription": ".keystore",
+    "SendWorker": ".sender",
+    "ObjectProcessor": ".processor",
+    "Cleaner": ".cleaner",
+    "CryptoPool": ".cryptopool",
+}
+
+__all__ = list(_EXPORTS)
+
+
+def __getattr__(name):
+    try:
+        module = _EXPORTS[name]
+    except KeyError:
+        raise AttributeError("module %r has no attribute %r"
+                             % (__name__, name)) from None
+    from importlib import import_module
+    return getattr(import_module(module, __name__), name)

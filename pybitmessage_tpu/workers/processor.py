@@ -4,6 +4,17 @@ Reference: class_objectProcessor.py — checkackdata (129-154),
 processgetpubkey (176-268), processpubkey (270-433), processmsg
 (435-747) with randomized decrypt-all-keys and anti-surreptitious-
 forwarding, processbroadcast (749-973).
+
+Ingest fast path (docs/ingest.md): the reference — and this repo
+before the ingest PR — ran every trial decrypt, signature check and
+SQL insert inline on the consumer (here: the asyncio event loop),
+stalling every connection read loop behind each object.  Now the
+stages pipeline: ``concurrency`` worker tasks pull from the queue in
+parallel, the crypto stages fan out on a sized worker pool
+(:class:`~pybitmessage_tpu.workers.cryptopool.CryptoPool`), and the
+store stage buffers rows into a write-behind drain
+(:class:`~pybitmessage_tpu.storage.writebehind.WriteBehindStore`) —
+the event loop never blocks on ECDH, ECDSA or SQLite.
 """
 
 from __future__ import annotations
@@ -14,8 +25,7 @@ import random
 import struct
 import time
 
-from ..crypto import decrypt, verify
-from ..crypto.ecies import DecryptionError
+from ..crypto.ecies import DecryptionError  # noqa: F401  (re-export)
 from ..gateways.email_account import (
     ALL_OK, REGISTRATION_DENIED, EmailGatewayAccount, spec_for_identity,
 )
@@ -36,6 +46,7 @@ from ..storage.messages import ACKRECEIVED, MessageStore
 from ..utils.addresses import encode_address
 from ..utils.hashes import address_ripe, inventory_hash, sha512
 from ..utils.varint import decode_varint, encode_varint
+from .cryptopool import CryptoPool
 from .keystore import KeyStore
 from .sender import SendWorker
 
@@ -50,6 +61,36 @@ OBJECTS_PROCESSED = REGISTRY.counter(
 PROCESS_SECONDS = REGISTRY.histogram(
     "worker_process_seconds",
     "Per-object processing latency (decrypt, verify, store)")
+STAGE_SECONDS = REGISTRY.histogram(
+    "ingest_stage_seconds",
+    "Per-stage ingest latency (parse, decrypt, sig_verify, store, "
+    "flush)", ("stage",))
+
+#: default concurrent objects in flight through the processor — the
+#: crypto stages await the worker pool, so this mainly sizes how much
+#: parse/store work can overlap a slow decrypt fan-out
+DEFAULT_CONCURRENCY = 8
+#: write-behind drain cadence, seconds
+DEFAULT_FLUSH_INTERVAL = 0.05
+
+
+class _Stage:
+    """Tiny context manager feeding one stage's wall time into
+    ``ingest_stage_seconds`` (a full tracer span per stage would pay
+    label+ring costs four times per object)."""
+
+    __slots__ = ("_child", "_t0")
+
+    def __init__(self, stage: str):
+        self._child = STAGE_SECONDS.labels(stage=stage)
+
+    def __enter__(self):
+        self._t0 = time.monotonic()
+        return self
+
+    def __exit__(self, *exc):
+        self._child.observe(time.monotonic() - self._t0)
+        return False
 
 
 class ObjectProcessor:
@@ -61,10 +102,22 @@ class ObjectProcessor:
                  shutdown: asyncio.Event | None = None,
                  min_ntpb: int = DEFAULT_NONCE_TRIALS_PER_BYTE,
                  min_extra: int = DEFAULT_EXTRA_BYTES,
-                 ui_signal=None):
+                 ui_signal=None, crypto: CryptoPool | None = None,
+                 concurrency: int = DEFAULT_CONCURRENCY,
+                 write_behind: bool = True,
+                 flush_interval: float = DEFAULT_FLUSH_INTERVAL):
         #: UISignaler.emit-compatible callback (may be None)
         self.ui_signal = ui_signal or (lambda cmd, data=(): None)
         self.keystore = keystore
+        #: crypto worker pool — the decrypt/sig-verify stages run here
+        self.crypto = crypto or CryptoPool()
+        #: write-behind: ingest-path rows coalesce into one
+        #: transaction per drain (storage/writebehind.py)
+        self._wb = None
+        if write_behind:
+            from ..storage.writebehind import WriteBehindStore
+            self._wb = WriteBehindStore(store)
+            store = self._wb
         self.store = store
         self.inventory = inventory
         self.sender = sender
@@ -73,6 +126,8 @@ class ObjectProcessor:
         self.shutdown = shutdown or asyncio.Event()
         self.min_ntpb = min_ntpb
         self.min_extra = min_extra
+        self.concurrency = max(1, concurrency)
+        self.flush_interval = flush_interval
         #: black/whitelist policy: 'black' (default) drops enabled
         #: blacklist rows, 'white' accepts only enabled whitelist rows
         #: (reference objectProcessor processmsg + bmconfigparser
@@ -83,6 +138,15 @@ class ObjectProcessor:
         from ..utils.queues import ByteBoundedQueue
         self.queue: asyncio.Queue = ByteBoundedQueue()
         self._task: asyncio.Task | None = None
+        self._tasks: list[asyncio.Task] = []
+        self._running = False
+        #: objects currently inside :meth:`process` (bench/idle probe)
+        self.active = 0
+        #: payload each worker task is currently processing — stop()
+        #: persists these alongside the queue so cancelling up to
+        #: ``concurrency`` mid-object workers loses nothing (replay is
+        #: idempotent: sighash dedup, pubkey REPLACE, ack updates)
+        self._inflight: dict[asyncio.Task, bytes] = {}
         # observability counters (reference state.numberOf*Processed)
         self.messages_processed = 0
         self.broadcasts_processed = 0
@@ -99,19 +163,34 @@ class ObjectProcessor:
                 logger.warning("dropping persisted object: queue full")
         if restored:
             logger.info("restored %d unprocessed objects", len(restored))
-        self._task = asyncio.create_task(self._run())
+        self._running = True
+        self._tasks = [asyncio.create_task(self._run())
+                       for _ in range(self.concurrency)]
+        if self._wb is not None:
+            self._tasks.append(asyncio.create_task(self._flush_loop()))
+        self._task = self._tasks[0]
         return self._task
 
     async def stop(self) -> None:
-        if self._task:
-            self._task.cancel()
-            try:
-                await self._task
-            except asyncio.CancelledError:
-                pass
+        self._running = False
+        # snapshot in-flight payloads BEFORE cancelling: each worker's
+        # finally pops its entry as the cancellation unwinds, and no
+        # await separates this snapshot from the cancel calls, so a
+        # worker can neither finish nor start an object in between
+        inflight = list(self._inflight.values())
+        for t in self._tasks:
+            t.cancel()
+        if self._tasks:
+            await asyncio.gather(*self._tasks, return_exceptions=True)
+        self._tasks = []
+        self._task = None
         # persist whatever we didn't get to (reference
-        # class_objectProcessor.py:111-127)
-        leftover = []
+        # class_objectProcessor.py:111-127) — INCLUDING objects a
+        # cancelled worker had in flight: with multiple await points
+        # per object, shutdown reliably lands mid-process, and those
+        # payloads are no longer in the queue
+        leftover = inflight
+        self._inflight.clear()
         while True:
             try:
                 leftover.append(self.queue.get_nowait())
@@ -120,20 +199,54 @@ class ObjectProcessor:
         if leftover:
             self.store.persist_objectprocessor_queue(leftover)
             logger.info("persisted %d unprocessed objects", len(leftover))
+        # drain the write-behind buffer — rows accepted before shutdown
+        # must land even when no flush tick got to them (chaos-tested:
+        # a db.write fault inside this flush is absorbed by the retry
+        # policy and the buffer survives a failed attempt)
+        if self._wb is not None and self._wb.pending_rows():
+            if not self._wb.flush():
+                self._wb.flush()     # one more drain after the backoff
+        self.crypto.close()
+
+    def pending(self) -> int:
+        """Objects queued or in flight (bench idle detection)."""
+        return self.queue.qsize() + self.active
+
+    async def _flush_loop(self) -> None:
+        """Write-behind drain cadence: one transaction per interval
+        when rows are buffered (size-triggered drains happen inline in
+        the store stage via ``should_flush``)."""
+        while not self.shutdown.is_set():
+            await asyncio.sleep(self.flush_interval)
+            if self._wb.pending_rows():
+                await self._flush_store()
+
+    async def _flush_store(self) -> None:
+        if self._wb is not None:
+            with _Stage("flush"):
+                await self.crypto.run(self._wb.flush)
 
     async def _run(self) -> None:
         while not self.shutdown.is_set():
             payload = await self.queue.get()
+            self.active += 1
+            self._inflight[asyncio.current_task()] = payload
             try:
                 await self.process(payload)
             except asyncio.CancelledError:
                 raise
             except Exception:
+                from ..resilience.policy import ERRORS
+                ERRORS.labels(site="ingest.process").inc()
                 logger.exception("object processing failed")
+            finally:
+                self.active -= 1
+                self._inflight.pop(asyncio.current_task(), None)
 
     async def process(self, payload: bytes) -> None:
         try:
-            header = ObjectHeader.parse(payload)
+            with _Stage("parse"):
+                header = ObjectHeader.parse(payload)
         except Exception:
             OBJECTS_PROCESSED.labels(type="unparseable").inc()
             return
@@ -146,13 +259,13 @@ class ObjectProcessor:
                     await self._process_getpubkey(header, payload)
                 elif header.object_type == OBJECT_PUBKEY:
                     kind = "pubkey"
-                    self._process_pubkey(header, payload)
+                    await self._process_pubkey(header, payload)
                 elif header.object_type == OBJECT_MSG:
                     kind = "msg"
                     await self._process_msg(header, payload)
                 elif header.object_type == OBJECT_BROADCAST:
                     kind = "broadcast"
-                    self._process_broadcast(header, payload)
+                    await self._process_broadcast(header, payload)
                 elif header.object_type == OBJECT_ONIONPEER:
                     kind = "onionpeer"
                     self._process_onionpeer(header, payload)
@@ -161,6 +274,15 @@ class ObjectProcessor:
             # count failed objects too — a raising handler must not
             # leave worker_process_seconds ahead of the counter
             OBJECTS_PROCESSED.labels(type=kind).inc()
+            if self._wb is not None:
+                if self._wb.should_flush():
+                    # size-triggered drain: a storm must not grow the
+                    # buffer unbounded between flush ticks
+                    await self._flush_store()
+                elif not self._running:
+                    # direct (un-started) calls keep write-through
+                    # visibility: every process() drains its rows
+                    await self._flush_store()
 
     # -- onionpeer -----------------------------------------------------------
 
@@ -238,7 +360,8 @@ class ObjectProcessor:
 
     # -- pubkey --------------------------------------------------------------
 
-    def _process_pubkey(self, header: ObjectHeader, payload: bytes) -> None:
+    async def _process_pubkey(self, header: ObjectHeader,
+                              payload: bytes) -> None:
         self.pubkeys_processed += 1
         i = header.header_length
         if header.version in (2, 3):
@@ -249,13 +372,16 @@ class ObjectProcessor:
                 # (objectProcessor.py:362-371)
                 span = _difficulty_span(payload, i + 4 + 128)
                 signed = payload[8:i + 4 + 128 + len(span)]
-                if not verify(signed, data.signature, data.pub_signing_key):
+                with _Stage("sig_verify"):
+                    ok = await self.crypto.verify(
+                        signed, data.signature, data.pub_signing_key)
+                if not ok:
                     logger.debug("v3 pubkey bad signature")
                     return
             ripe = address_ripe(data.pub_signing_key,
                                 data.pub_encryption_key)
             address = encode_address(header.version, header.stream, ripe)
-            self._store_pubkey(address, header.version, payload[i:])
+            await self._store_pubkey(address, header.version, payload[i:])
         elif header.version == 4:
             tag = payload[i:i + 32]
             # can only decrypt if we're awaiting this tag
@@ -264,25 +390,41 @@ class ObjectProcessor:
                 return
             from ..utils.addresses import decode_address
             to = decode_address(toaddress)
-            data = self.sender._decrypt_pubkey_object(payload, to)
+            with _Stage("decrypt"):
+                data = await self.crypto.run(
+                    self.sender._decrypt_pubkey_object, payload, to)
             if data is None:
                 logger.debug("v4 pubkey failed decrypt/verify")
                 return
             from .sender import _pubkey_inner_bytes
-            self._store_pubkey(toaddress, 4, _pubkey_inner_bytes(data),
-                               used_personally=True)
-            del self.sender.needed_pubkeys[tag]
+            await self._store_pubkey(toaddress, 4,
+                                     _pubkey_inner_bytes(data),
+                                     used_personally=True)
+            self.sender.needed_pubkeys.pop(tag, None)
 
-    def _store_pubkey(self, address: str, version: int, inner: bytes,
-                      used_personally: bool = False) -> None:
-        self.store.store_pubkey(address, version, inner, used_personally)
+    async def _store_pubkey(self, address: str, version: int, inner: bytes,
+                            used_personally: bool = False) -> None:
+        with _Stage("store"):
+            self.store.store_pubkey(address, version, inner,
+                                    used_personally)
         logger.info("stored pubkey for %s", address)
+        # pubkeys gate the send pipeline, whose workers read through
+        # the UNBUFFERED store — drain now so the key (and any status
+        # flips below) are visible before the sender wakes.  This is
+        # deliberately unconditional: a send can flip to
+        # awaitingpubkey between our waiting-check below and the next
+        # drain tick, and its lookup must find the committed key.
+        # Cost is bounded by the pre-ingest-PR baseline (one commit
+        # per pubkey object); msg floods stay coalesced.
+        await self._flush_store()
         # unblock any sends waiting on it (possibleNewPubkey analog)
-        waiting = self.store.sent_by_status("awaitingpubkey")
+        waiting = await self.crypto.run(
+            self.store.sent_by_status, "awaitingpubkey")
         if any(m.toaddress == address for m in waiting):
             for m in waiting:
                 if m.toaddress == address:
                     self.store.update_sent_status(m.ackdata, "msgqueued")
+            await self._flush_store()
             self.sender.queue.put_nowait(("sendmessage",))
 
     # -- msg -----------------------------------------------------------------
@@ -295,22 +437,21 @@ class ObjectProcessor:
         i = header.header_length
         encrypted = payload[i:]
 
-        # try-decrypt against all our keys in RANDOMIZED order,
-        # continuing after success to blunt timing attacks
-        # (objectProcessor.py:459-477)
-        decrypted = None
-        match = None
+        # try-decrypt against all our keys in RANDOMIZED order, fanned
+        # across the crypto pool with first-match early-cancel
+        # (reference decrypts every key inline on one thread,
+        # objectProcessor.py:459-477 — the randomized order is kept,
+        # and off-loop execution replaces decrypt-all as the timing
+        # defense: the event loop no longer times the key sweep)
         idents = list(self.keystore.identities.values())
         random.shuffle(idents)
-        for ident in idents:
-            try:
-                out = decrypt(encrypted, ident.priv_encryption)
-                if decrypted is None:
-                    decrypted, match = out, ident
-            except DecryptionError:
-                continue
-        if decrypted is None:
+        with _Stage("decrypt"):
+            matches = await self.crypto.try_decrypt_many(
+                encrypted, [(ident.priv_encryption, ident)
+                            for ident in idents])
+        if not matches:
             return
+        decrypted, match = matches[0]
 
         try:
             plain = MsgPlaintext.decode(decrypted)
@@ -324,17 +465,21 @@ class ObjectProcessor:
             return
         signed = msg_signed_data(payload, header.version, header.stream,
                                  decrypted[:plain.signed_span])
-        if not verify(signed, plain.signature, plain.pub_signing_key):
+        with _Stage("sig_verify"):
+            sig_ok = await self.crypto.verify(signed, plain.signature,
+                                              plain.pub_signing_key)
+        if not sig_ok:
             logger.debug("msg signature invalid")
             return
-        # demanded-difficulty recheck (objectProcessor.py:615-629)
+        # demanded-difficulty recheck (objectProcessor.py:615-629);
+        # pow_value double-hashes the whole payload — off the loop too
         if not match.chan:
             req_ntpb = max(match.nonce_trials_per_byte, self.min_ntpb)
             req_extra = max(match.extra_bytes, self.min_extra)
             ttl = max(header.expires - int(time.time()), 300)
             demanded = pow_target(len(payload), ttl, req_ntpb, req_extra,
                                   clamp=False)
-            if pow_value(payload) > demanded:
+            if await self.crypto.run(pow_value, payload) > demanded:
                 logger.info("msg PoW below our demanded difficulty")
                 return
 
@@ -345,8 +490,13 @@ class ObjectProcessor:
         sighash = sha512(plain.signature)
         # black/whitelist policy, before any inbox insert — applied to
         # chan recipients too: the reference computes blockMessage
-        # unconditionally for every msg (objectProcessor processmsg)
-        if not self.store.sender_allowed(from_address, self.list_mode):
+        # unconditionally for every msg (objectProcessor processmsg).
+        # The policy lookup is a SQL read — off the loop with the rest
+        # of the store stage.
+        with _Stage("store"):
+            allowed = await self.crypto.run(
+                self.store.sender_allowed, from_address, self.list_mode)
+        if not allowed:
             logger.info("message from %s dropped by %slist policy",
                         from_address, self.list_mode)
             return
@@ -364,11 +514,16 @@ class ObjectProcessor:
             acct = EmailGatewayAccount(match.address, gw_spec)
             display_from, subject, feedback = acct.parse_incoming(
                 from_address, subject)
-        if not self.store.deliver_inbox(
-                msgid=inventory_hash(payload), toaddress=match.address,
-                fromaddress=display_from, subject=subject,
-                message=body.body, encoding=plain.encoding,
-                sighash=sighash):
+        with _Stage("store"):
+            # buffered when write-behind is on (the sighash dedup is
+            # buffer-aware); the direct store still runs off the loop
+            delivered = await self.crypto.run(
+                lambda: self.store.deliver_inbox(
+                    msgid=inventory_hash(payload),
+                    toaddress=match.address, fromaddress=display_from,
+                    subject=subject, message=body.body,
+                    encoding=plain.encoding, sighash=sighash))
+        if not delivered:
             logger.debug("duplicate message dropped (sighash)")
             return
         # denial surfaced only for the first (non-duplicate) delivery —
@@ -449,8 +604,8 @@ class ObjectProcessor:
 
     # -- broadcast -----------------------------------------------------------
 
-    def _process_broadcast(self, header: ObjectHeader,
-                           payload: bytes) -> None:
+    async def _process_broadcast(self, header: ObjectHeader,
+                                 payload: bytes) -> None:
         self.broadcasts_processed += 1
         i = header.header_length
         if header.version == 5:
@@ -464,11 +619,12 @@ class ObjectProcessor:
         else:
             return
         encrypted = payload[i:]
-        for sub in subs:
-            try:
-                decrypted = decrypt(encrypted, sub.broadcast_key)
-            except DecryptionError:
-                continue
+        # subscription keys fan across the crypto pool like identity
+        # keys do for msgs (v4 broadcasts trial every legacy sub key)
+        with _Stage("decrypt"):
+            matches = await self.crypto.try_decrypt_many(
+                encrypted, [(s.broadcast_key, s) for s in subs])
+        for decrypted, sub in matches:
             try:
                 plain = BroadcastPlaintext.decode(decrypted)
             except PayloadError:
@@ -482,15 +638,21 @@ class ObjectProcessor:
                 payload[8:header.header_length
                         + (32 if header.version == 5 else 0)],
                 decrypted[:plain.signed_span])
-            if not verify(signed, plain.signature, plain.pub_signing_key):
+            with _Stage("sig_verify"):
+                sig_ok = await self.crypto.verify(
+                    signed, plain.signature, plain.pub_signing_key)
+            if not sig_ok:
                 logger.debug("broadcast signature invalid")
                 continue
             body = msgcoding.decode_message(plain.message, plain.encoding)
-            self.store.deliver_inbox(
-                msgid=inventory_hash(payload), toaddress="[Broadcast]",
-                fromaddress=sub.address, subject=body.subject,
-                message=body.body, encoding=plain.encoding,
-                sighash=sha512(plain.signature))
+            with _Stage("store"):
+                await self.crypto.run(
+                    lambda: self.store.deliver_inbox(
+                        msgid=inventory_hash(payload),
+                        toaddress="[Broadcast]", fromaddress=sub.address,
+                        subject=body.subject, message=body.body,
+                        encoding=plain.encoding,
+                        sighash=sha512(plain.signature)))
             logger.info("broadcast delivered from %s", sub.address)
             self.ui_signal("displayNewInboxMessage",
                            (inventory_hash(payload), "[Broadcast]",

@@ -1,0 +1,358 @@
+"""Ingest fast path (ISSUE 4): crypto pool, write-behind, watermarks.
+
+Everything in this file is tier-1-safe on the minimal CI image: the
+CryptoPool tests inject fake decrypt/verify callables (the pool's
+fan-out/early-cancel mechanics are independent of the optional
+``cryptography`` package), the write-behind tests run against the
+real SQLite store, and the chaos test drives the seeded ``db.write``
+site through the same retry path production uses.  The full
+crypto-to-store pipeline is exercised end-to-end by ``bench.py
+ingest_storm`` (smoke mode in ``make bench-smoke``) wherever
+``cryptography`` is installed.
+"""
+
+import asyncio
+import threading
+import time
+
+import pytest
+
+from pybitmessage_tpu.observability import REGISTRY
+from pybitmessage_tpu.resilience import CHAOS
+from pybitmessage_tpu.storage.db import Database
+from pybitmessage_tpu.storage.messages import MessageStore
+from pybitmessage_tpu.storage.writebehind import WriteBehindStore
+from pybitmessage_tpu.utils.queues import WatermarkQueue
+from pybitmessage_tpu.workers.cryptopool import CryptoPool
+
+# ---------------------------------------------------------------------------
+# watermark backpressure
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.asyncio
+async def test_watermark_queue_pauses_and_resumes():
+    q = WatermarkQueue(high=4, low=2)
+    for i in range(3):
+        q.put_nowait(i)
+    assert not q.paused
+    q.put_nowait(3)                  # crosses HIGH
+    assert q.paused
+
+    waited = asyncio.create_task(q.wait_resume())
+    await asyncio.sleep(0.01)
+    assert not waited.done(), "reader must stall above the high mark"
+
+    q.get_nowait()                   # 3 left: still paused (hysteresis)
+    assert q.paused
+    q.get_nowait()                   # 2 left == LOW: resume
+    await asyncio.sleep(0.01)
+    assert waited.done() and not q.paused
+
+
+@pytest.mark.asyncio
+async def test_watermark_queue_disabled_never_pauses():
+    q = WatermarkQueue(high=0)
+    for i in range(1000):
+        q.put_nowait(i)
+    assert not q.paused
+    await q.wait_resume()            # returns immediately
+
+
+def test_node_context_object_queue_is_watermarked():
+    from pybitmessage_tpu.network.pool import NodeContext
+    from pybitmessage_tpu.storage.knownnodes import KnownNodes
+
+    ctx = NodeContext(inventory={}, knownnodes=KnownNodes(None),
+                      ingest_high=7)
+    assert isinstance(ctx.object_queue, WatermarkQueue)
+    assert ctx.object_queue.high == 7
+
+
+# ---------------------------------------------------------------------------
+# crypto pool mechanics (injected callables — no `cryptography` needed)
+# ---------------------------------------------------------------------------
+
+
+def _fake_decrypt_for(good_key: bytes, plaintext: bytes = b"plain",
+                      cost: float = 0.0, calls: list | None = None):
+    def fake(payload: bytes, priv: bytes) -> bytes:
+        if calls is not None:
+            calls.append(priv)
+        if cost:
+            time.sleep(cost)
+        if priv == good_key:
+            return plaintext
+        raise ValueError("MAC mismatch")
+    return fake
+
+
+@pytest.mark.asyncio
+async def test_try_decrypt_many_finds_the_one_key():
+    pool = CryptoPool(size=2, decrypt_fn=_fake_decrypt_for(b"k2"))
+    try:
+        keys = [(b"k%d" % i, "ident%d" % i) for i in range(5)]
+        matches = await pool.try_decrypt_many(b"payload", keys)
+        assert matches == [(b"plain", "ident2")]
+        assert await pool.try_decrypt_many(
+            b"payload", [(b"nope", "x")]) == []
+    finally:
+        pool.close()
+
+
+@pytest.mark.asyncio
+async def test_try_decrypt_many_early_cancel_skips_queued_work():
+    """With one worker the attempts serialize; once the first key
+    matches, every queued attempt must short-circuit on the shared
+    found-event instead of paying the decrypt."""
+    calls: list = []
+    pool = CryptoPool(size=1,
+                      decrypt_fn=_fake_decrypt_for(b"k0", calls=calls))
+    try:
+        keys = [(b"k%d" % i, i) for i in range(16)]
+        matches = await pool.try_decrypt_many(b"payload", keys)
+        assert matches == [(b"plain", 0)]
+        # the match ran; the 15 queued attempts saw the event and
+        # returned without "decrypting" (their priv never recorded)
+        assert calls == [b"k0"]
+        assert REGISTRY.sample("crypto_decrypt_early_cancel_total") >= 15
+    finally:
+        pool.close()
+
+
+@pytest.mark.asyncio
+async def test_inline_pool_runs_without_threads():
+    pool = CryptoPool(size=0, decrypt_fn=_fake_decrypt_for(b"k1"),
+                      verify_fn=lambda d, s, p: s == b"good")
+    matches = await pool.try_decrypt_many(
+        b"x", [(b"k0", "a"), (b"k1", "b"), (b"k2", "c")])
+    assert matches == [(b"plain", "b")]
+    assert await pool.verify(b"d", b"good", b"p") is True
+    assert await pool.verify_many(
+        [(b"d", b"good", b"p"), (b"d", b"bad", b"p")]) == [True, False]
+    assert pool._exec is None, "size=0 must never spawn threads"
+
+
+@pytest.mark.asyncio
+async def test_verify_many_fans_across_workers():
+    seen_threads = set()
+
+    def fake_verify(data, sig, pub):
+        seen_threads.add(threading.get_ident())
+        time.sleep(0.01)
+        return True
+
+    pool = CryptoPool(size=4, verify_fn=fake_verify)
+    try:
+        out = await pool.verify_many([(b"d", b"s", b"p")] * 8)
+        assert out == [True] * 8
+        assert len(seen_threads) > 1, "checks must fan across workers"
+        assert threading.main_thread().ident not in seen_threads
+    finally:
+        pool.close()
+
+
+# ---------------------------------------------------------------------------
+# write-behind storage
+# ---------------------------------------------------------------------------
+
+
+def _wb() -> tuple[Database, MessageStore, WriteBehindStore]:
+    db = Database()
+    store = MessageStore(db)
+    return db, store, WriteBehindStore(store)
+
+
+def test_write_behind_coalesces_and_flushes():
+    _, store, wb = _wb()
+    for i in range(5):
+        assert wb.deliver_inbox(
+            msgid=b"m%d" % i, toaddress="to", fromaddress="fr",
+            subject="s%d" % i, message="body", sighash=b"h%d" % i)
+    wb.store_pubkey("BM-peer", 4, b"pk")
+    wb.update_sent_status(b"ack", "ackreceived")
+    assert wb.pending_rows() == 7
+    assert store.inbox() == []       # nothing hit SQL yet
+    assert wb.flush()
+    assert wb.pending_rows() == 0
+    assert len(store.inbox()) == 5
+    assert store.get_pubkey("BM-peer") == b"pk"
+
+
+def test_write_behind_dedup_spans_buffer_and_database():
+    _, store, wb = _wb()
+    assert wb.deliver_inbox(msgid=b"m1", toaddress="t", fromaddress="f",
+                            subject="s", message="b", sighash=b"same")
+    # duplicate while still buffered
+    assert not wb.deliver_inbox(msgid=b"m2", toaddress="t",
+                                fromaddress="f", subject="s",
+                                message="b", sighash=b"same")
+    wb.flush()
+    # duplicate after the row landed in SQL
+    assert not wb.deliver_inbox(msgid=b"m3", toaddress="t",
+                                fromaddress="f", subject="s",
+                                message="b", sighash=b"same")
+    assert len(store.inbox()) == 1
+
+
+def test_write_behind_pubkey_read_your_write():
+    _, store, wb = _wb()
+    wb.store_pubkey("BM-a", 4, b"payload-a")
+    assert wb.get_pubkey("BM-a") == b"payload-a"   # pre-flush
+    wb.flush()
+    assert wb.get_pubkey("BM-a") == b"payload-a"   # post-flush
+    assert wb.get_pubkey("BM-missing") is None
+
+
+def test_write_behind_passthrough_to_wrapped_store():
+    _, store, wb = _wb()
+    wb.queue_sent(msgid=b"m", toaddress="BM-t", toripe=b"r",
+                  fromaddress="BM-f", subject="s", message="b",
+                  ackdata=b"ack", ttl=600)
+    wb.update_sent_status(b"ack", "msgsent")
+    wb.flush()
+    assert store.sent_by_ackdata(b"ack").status == "msgsent"
+
+
+def test_write_behind_flush_survives_shutdown_under_db_chaos():
+    """ISSUE 4 satellite: buffered rows survive a shutdown drain that
+    hits seeded ``db.write`` faults — absorbed ones by the retry
+    policy inside one transaction, a fully-failed drain by keeping the
+    buffer intact for the follow-up flush.  No row is ever lost."""
+    _, store, wb = _wb()
+    for i in range(8):
+        wb.deliver_inbox(msgid=b"c%d" % i, toaddress="t",
+                         fromaddress="f", subject="s%d" % i,
+                         message="b", sighash=b"ch%d" % i)
+    wb.update_sent_status(b"ack", "ackreceived")
+
+    # 1) faults absorbed by the write retry: one drain succeeds
+    CHAOS.arm("db.write", probability=1.0, count=2)
+    try:
+        assert wb.flush()
+    finally:
+        CHAOS.disarm()
+    assert wb.pending_rows() == 0
+    assert len(store.inbox()) == 8
+
+    # 2) persistent faults: the drain fails, rows stay buffered, and
+    # the shutdown path's follow-up flush lands them once the fault
+    # clears — the exact sequence ObjectProcessor.stop runs
+    for i in range(3):
+        wb.deliver_inbox(msgid=b"d%d" % i, toaddress="t",
+                         fromaddress="f", subject="x%d" % i,
+                         message="b", sighash=b"dh%d" % i)
+    CHAOS.arm("db.write", probability=1.0, count=50)
+    try:
+        assert not wb.flush()
+    finally:
+        CHAOS.disarm()
+    assert wb.pending_rows() == 3, "failed drain must keep every row"
+    assert wb.flush()
+    assert len(store.inbox()) == 11
+
+
+def test_write_behind_flush_metrics_registered():
+    """The new ingest metrics exist under their lint-clean names."""
+    # (ingest_stage_seconds lives in workers/processor.py, which needs
+    # the optional `cryptography` package — the naming lint in
+    # test_observability.py covers it wherever that module imports)
+    for name in ("storage_write_behind_flush_size",
+                 "storage_write_behind_flushes_total",
+                 "storage_write_behind_pending",
+                 "ingest_queue_depth", "ingest_pause_total",
+                 "crypto_pool_ops_total", "crypto_decrypt_fanout_size",
+                 "crypto_decrypt_early_cancel_total"):
+        assert REGISTRY.get(name) is not None, name
+
+
+# ---------------------------------------------------------------------------
+# BatchVerifier shutdown settlement (ISSUE 4 satellite)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.asyncio
+async def test_batch_verifier_stop_settles_pending_as_unverified():
+    from pybitmessage_tpu.pow.verify_service import BatchVerifier
+
+    v = BatchVerifier(use_device=False, window=60.0)  # drain never fires
+    v.start()
+    payload = b"\x00" * 8 + int(time.time() + 600).to_bytes(8, "big") \
+        + b"\x00\x00\x00\x01" + b"x" * 20
+    before = REGISTRY.sample("pow_verify_shutdown_unverified_total")
+    checks = [asyncio.create_task(v.check(payload)) for _ in range(3)]
+    await asyncio.sleep(0.05)        # all three queued behind the window
+    await v.stop()
+    results = await asyncio.gather(*checks)
+    assert results == [False, False, False], (
+        "pending checks must settle as unverified, not cancel")
+    after = REGISTRY.sample("pow_verify_shutdown_unverified_total")
+    assert after - before == 3
+
+
+@pytest.mark.asyncio
+async def test_batch_verifier_cancel_mid_device_batch_settles():
+    """Cancellation landing INSIDE a device batch (not just at the
+    queue wait) must still settle every popped future."""
+    from pybitmessage_tpu.pow.verify_service import BatchVerifier
+
+    release = asyncio.Event()
+
+    class _Hang(BatchVerifier):
+        async def _device_verify(self, objects):
+            await release.wait()            # park mid-batch
+            return [True] * len(objects)
+
+    v = _Hang(use_device=True, min_device_batch=1, window=0.0)
+    v.start()
+    payload = b"\x00" * 8 + int(time.time() + 600).to_bytes(8, "big") \
+        + b"\x00\x00\x00\x01" + b"x" * 20
+    checks = [asyncio.create_task(v.check(payload)) for _ in range(2)]
+    await asyncio.sleep(0.05)               # drain popped them, parked
+    assert v.queue.empty(), "batch must be in flight, not queued"
+    await v.stop()
+    results = await asyncio.gather(*checks)
+    assert results == [False, False], (
+        "futures popped into an in-flight batch must settle at stop")
+
+
+@pytest.mark.asyncio
+async def test_processor_stop_persists_inflight_objects():
+    """Workers cancelled mid-process must hand their payload back to
+    the objectprocessorqueue persistence, not lose it (the processor
+    pipeline widened the in-flight window to `concurrency` objects)."""
+    from types import SimpleNamespace
+
+    proc = SimpleNamespace()            # minimal stand-in store
+    persisted = []
+
+    class _Store:
+        def pop_objectprocessor_queue(self):
+            return []
+
+        def persist_objectprocessor_queue(self, payloads):
+            persisted.extend(payloads)
+
+    # ObjectProcessor needs `cryptography` at import; exercise the
+    # same contract through a faithful copy of its worker/stop logic
+    # is NOT acceptable — import if available, else skip
+    pytest.importorskip("cryptography")
+    from pybitmessage_tpu.workers.processor import ObjectProcessor
+
+    proc = ObjectProcessor(
+        keystore=SimpleNamespace(identities={}), store=_Store(),
+        inventory=None, sender=SimpleNamespace(), write_behind=False)
+    started = asyncio.Event()
+
+    async def hang(payload):
+        started.set()
+        await asyncio.sleep(60)
+
+    proc.process = hang
+    proc.start()
+    await proc.queue.put(b"payload-in-flight")
+    await asyncio.wait_for(started.wait(), 5)
+    await proc.queue.put(b"payload-still-queued")
+    await proc.stop()
+    assert sorted(persisted) == [b"payload-in-flight",
+                                 b"payload-still-queued"]

@@ -365,6 +365,9 @@ def test_metric_naming_conventions():
             "pybitmessage_tpu.network.connection",
             "pybitmessage_tpu.network.pool",
             "pybitmessage_tpu.storage.inventory",
+            "pybitmessage_tpu.storage.writebehind",
+            "pybitmessage_tpu.utils.queues",
+            "pybitmessage_tpu.workers.cryptopool",
             "pybitmessage_tpu.workers.sender",
             "pybitmessage_tpu.workers.processor"):
         try:

@@ -89,3 +89,40 @@ def test_pallas_sharded_1d_mesh_batch_falls_back():
         items, mesh, rows=1, chunks_per_call=4, impl="xla")
     for (nonce, _), (ih, target) in zip(results, items):
         assert _host_trial(nonce, ih) <= target
+
+
+def test_pallas_sharded_batch_resumes_from_start_nonces():
+    """ISSUE 4 satellite (ROADMAP known gap): journaled resume offsets
+    reach the pod-sharded batch loop — the search starts AT the
+    checkpoint instead of re-searching from nonce 0, and miss-free
+    harvests report monotonic progress checkpoints beyond it."""
+    mesh = make_mesh(2, obj_axis="obj", obj_size=1)
+    ih = hashlib.sha512(b"pod resume").digest()
+    target = 2**53           # ~1 in 2k trials: a few 256-trial slabs
+    offset = 1 << 20
+    seen = []
+    results = pallas_sharded_solve_batch(
+        [(ih, target)], mesh, rows=1, chunks_per_call=1, impl="xla",
+        start_nonces=[offset],
+        progress=lambda i, nxt: seen.append((i, nxt)))
+    nonce, trials = results[0]
+    assert _host_trial(nonce, ih) <= target
+    assert nonce >= offset, "search must resume at the checkpoint"
+    for i, nxt in seen:
+        assert i == 0
+        assert nxt > offset
+    nxts = [n for _, n in seen]
+    assert nxts == sorted(nxts), "checkpoints must be monotonic"
+
+
+def test_pallas_sharded_single_reports_progress():
+    mesh = make_mesh(2)
+    ih = hashlib.sha512(b"sharded single progress").digest()
+    seen = []
+    nonce, _ = pallas_sharded_solve(
+        ih, 2**53, mesh, rows=1, chunks_per_call=1, impl="xla",
+        start_nonce=512, progress=seen.append)
+    assert _host_trial(nonce, ih) <= 2**53
+    assert nonce >= 512
+    assert all(nxt > 512 for nxt in seen)
+    assert seen == sorted(seen)
