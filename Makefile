@@ -24,8 +24,10 @@ chaos: native
 		tests/test_resilience.py tests/test_resilience_chaos.py \
 		-q -m 'not slow'
 
-# tiny CPU-only pipeline bench for CI: reduced slabs, reference
-# test-mode difficulty, XLA impl (see docs/pow_pipeline.md)
+# tiny CPU-only bench for CI: reduced slabs, reference test-mode
+# difficulty, XLA impl (docs/pow_pipeline.md), plus the ingest_storm
+# and sync_storm smoke sections — the sync mesh must converge with
+# zero object loss (docs/sync.md) or the run fails
 bench-smoke:
 	JAX_PLATFORMS=cpu python bench.py --smoke
 

@@ -787,6 +787,107 @@ class _NullHist:
         return 0.0
 
 
+# -- set-reconciliation sync (ISSUE 5) ---------------------------------------
+
+def _bench_sync_storm(peers: int = 8, objects: int = 10000,
+                      smoke: bool = False) -> dict:
+    """Bytes-on-wire per delivered object: sketch reconciliation vs
+    classic inv flooding across a simulated peer mesh (sync/mesh.py —
+    real Reconciler/codec state machines over an in-memory transport).
+
+    The scenario is a REJOIN + STORM: nodes come up holding largely-
+    overlapping inventories (each missing a random ~2% of the base
+    set), connect one link per tick, then ride out a live-injection
+    storm.  The flooding baseline does what the current stack does —
+    full big-inv per direction at establishment plus per-object inv
+    flooding; sync mode runs digest-sized IBLT catch-up plus periodic
+    pending-set reconciliation with a sqrt-fanout flood hybrid.
+
+    Acceptance (full mode): >=5x reduction in announcement-layer
+    bytes per delivered object at 10k objects / 8 peers, with zero
+    objects lost (every peer converges to the full inventory).
+    """
+    import asyncio
+    import os
+    import random as _random
+
+    from pybitmessage_tpu.sync.mesh import Mesh
+
+    live = max(objects // 8, 8)
+    base_n = objects - live
+    missing_frac = 0.02
+    per_tick = max(live // 40, 1)
+
+    async def run(sync: bool, fanout):
+        mesh = Mesh(peers, sync=sync, fanout=fanout)
+        rng = _random.Random(7)
+        base = [hashlib.sha512(b"sync base %d" % i).digest()[:32]
+                for i in range(base_n)]
+        held0 = 0
+        for i in range(peers):
+            missing = set(rng.sample(range(base_n),
+                                     int(base_n * missing_frac)))
+            seed = [h for j, h in enumerate(base) if j not in missing]
+            mesh.seed(i, seed)
+            held0 += len(seed)
+        await mesh.establish()
+        estab_ann = mesh.stats.announce_bytes
+        injected = 0
+        while injected < live:
+            for _ in range(min(per_tick, live - injected)):
+                mesh.inject(rng.randrange(peers), os.urandom(32))
+                injected += 1
+            await mesh.tick()
+        ticks = await mesh.run_until_converged()
+        # zero-loss acceptance: every peer holds the full inventory
+        for node in mesh.nodes:
+            assert len(node.inventory) == objects, (
+                "node %d converged to %d of %d objects"
+                % (node.index, len(node.inventory), objects))
+        delivered = peers * objects - held0
+        return mesh, estab_ann, delivered, ticks
+
+    flood, flood_estab, delivered, _ = asyncio.run(run(False, None))
+    sync, sync_estab, _, extra_ticks = asyncio.run(run(True, 1))
+
+    def per_mode(mesh, estab_ann):
+        ann = mesh.stats.announce_bytes
+        return {
+            "announce_bytes": ann,
+            "announce_bytes_establishment": estab_ann,
+            "announce_bytes_storm": ann - estab_ann,
+            "total_bytes": mesh.stats.total_bytes,
+            "bytes_per_delivered_object": round(ann / delivered, 1),
+            "by_command": dict(sorted(
+                mesh.stats.bytes_by_command.items())),
+        }
+
+    ratio = flood.stats.announce_bytes / max(
+        sync.stats.announce_bytes, 1)
+    out = {
+        "peers": peers, "objects": objects,
+        "seeded_overlap": 1.0 - missing_frac, "live_injected": live,
+        "delivered_objects": delivered,
+        "flooding": per_mode(flood, flood_estab),
+        "reconciliation": per_mode(sync, sync_estab),
+        "announce_reduction_x": round(ratio, 2),
+        "catchup_reduction_x": round(
+            flood_estab / max(sync_estab, 1), 2),
+        "storm_reduction_x": round(
+            (flood.stats.announce_bytes - flood_estab)
+            / max(sync.stats.announce_bytes - sync_estab, 1), 2),
+        "zero_objects_lost": True,
+        "sync_extra_convergence_ticks": extra_ticks,
+        "diff_p90": round((REGISTRY.get("sync_diff_size") or
+                           _NullHist()).percentile(0.9), 1),
+    }
+    if not smoke:
+        # acceptance: >=5x announcement-bandwidth reduction, no loss
+        assert ratio >= 5.0, (
+            "sync reduced announce bytes only %.2fx (need >=5x)" % ratio)
+    return out
+
+
 def _smoke_main() -> int:
     """Tiny CPU-only bench for CI (``make bench-smoke``): reduced
     slabs, reference test-mode difficulty, XLA impl — exercises the
@@ -875,6 +976,16 @@ def _smoke_main() -> int:
         configs["ingest_storm"] = {"skipped": repr(exc)[:120]}
     except Exception as exc:
         configs["ingest_storm"] = {"error": repr(exc)[:200]}
+    # set-reconciliation sync (ISSUE 5): tiny rejoin+storm mesh — the
+    # zero-loss invariant holds in smoke too; an AssertionError (an
+    # object lost) must fail CI, not hide in the JSON
+    try:
+        configs["sync_storm"] = _bench_sync_storm(
+            peers=6, objects=600, smoke=True)
+    except AssertionError:
+        raise
+    except Exception as exc:
+        configs["sync_storm"] = {"error": repr(exc)[:200]}
     print(json.dumps({
         "metric": "double_sha512_trial_hashes_per_sec_per_chip",
         "value": round(device, 1),
@@ -950,6 +1061,15 @@ def main():
         configs["ingest_storm"] = {"skipped": repr(exc)[:120]}
     except Exception as exc:
         configs["ingest_storm"] = {"error": repr(exc)[:200]}
+    # set-reconciliation sync (ISSUE 5): full 8-peer / 10k-object
+    # rejoin+storm mesh — the >=5x announce-bandwidth acceptance and
+    # the zero-loss invariant are asserted, and must fail the bench
+    try:
+        configs["sync_storm"] = _bench_sync_storm()
+    except AssertionError:
+        raise
+    except Exception as exc:
+        configs["sync_storm"] = {"error": repr(exc)[:200]}
     # measured MFU from a profiler trace (device-side kernel time);
     # the wall-clock u32_ops_per_sec stays alongside for continuity
     mfu_info = None

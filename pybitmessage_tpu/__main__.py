@@ -114,7 +114,8 @@ async def run(args) -> int:
                 tls_enabled=settings.getbool("tls"),
                 udp_enabled=settings.getbool("udp") and not args.no_listen,
                 inventory_backend=settings.get("inventorystorage"),
-                pow_window=settings.getfloat("powbatchwindow"))
+                pow_window=settings.getfloat("powbatchwindow"),
+                sync_enabled=settings.getbool("syncenabled"))
     node.settings = settings
     node.dandelion.stem_probability = settings.getint("dandelion")
     node.processor.list_mode = settings.get("blackwhitelist")
@@ -132,6 +133,15 @@ async def run(args) -> int:
     node.ctx.upload_bucket.rate = settings.getint("maxuploadrate") * 1024
     node.pool.max_outbound = settings.getint("maxoutboundconnections")
     node.pool.max_total = settings.getint("maxtotalconnections")
+    # set-reconciliation sync knobs (docs/sync.md)
+    if node.reconciler is not None:
+        node.reconciler.interval = settings.getfloat("syncinterval")
+        fanout = settings.getint("syncfanout")
+        node.reconciler.fanout = None if fanout < 0 else fanout
+        node.reconciler.breaker_threshold = \
+            settings.getint("breakerfailures")
+        node.reconciler.breaker_cooldown = \
+            settings.getfloat("breakercooldown")
     # resilience knobs (docs/resilience.md)
     node.pool.dial_timeout = settings.getfloat("connecttimeout")
     node.pool.handshake_timeout = settings.getfloat("handshaketimeout")

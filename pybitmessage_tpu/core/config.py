@@ -87,6 +87,15 @@ DEFAULTS: dict[str, str] = {
     "ingestqueuehigh": "512",        # object-queue high watermark
                                      # pausing connection reads
                                      # (0 = never pause)
+    # -- set-reconciliation sync (docs/sync.md) --
+    "syncenabled": "true",           # sketch-based inventory sync
+                                     # (negotiated; old peers keep
+                                     # classic inv flooding)
+    "syncinterval": "10",            # min seconds between
+                                     # reconciliation rounds per peer
+    "syncfanout": "-1",              # peers flooded immediately per
+                                     # new object: -1 = auto sqrt(n),
+                                     # 0 = pure reconciliation
     # -- resilience (docs/resilience.md) --
     "powstalltimeout": "120",        # per-harvest slab stall deadline,
                                      # seconds (0 = watchdog off)
@@ -154,6 +163,9 @@ VALIDATORS: dict[str, Callable[[str], bool]] = {
     "ingestworkers": _validate_int_range(1, 256),
     "cryptoworkers": _validate_int_range(0, 256),
     "ingestqueuehigh": _validate_int_range(0, 1 << 20),
+    "syncenabled": _validate_bool,
+    "syncinterval": _validate_float_range(0.5, 3600.0),
+    "syncfanout": _validate_int_range(-1, 1000),
     "powstalltimeout": _validate_float_range(0.0, 86400.0),
     "powmaxretries": _validate_int_range(1, 100),
     "breakerfailures": _validate_int_range(1, 1000),

@@ -41,7 +41,8 @@ class Node:
                  stream: int = 1, test_mode: bool = False,
                  tls_enabled: bool = True, udp_enabled: bool = False,
                  inventory_backend: str = "sqlite",
-                 pow_window: float | None = None):
+                 pow_window: float | None = None,
+                 sync_enabled: bool = True):
         self.data_dir = Path(data_dir) if data_dir else None
         if self.data_dir:
             self.data_dir.mkdir(parents=True, exist_ok=True)
@@ -79,6 +80,21 @@ class Node:
             announce_buckets=2 if test_mode else None)
         self.pool = ConnectionPool(self.ctx)
         self.listen = listen
+        #: set-reconciliation sync (docs/sync.md): sketch exchanges
+        #: replace most per-object inv flooding for NODE_SYNC peers
+        self.reconciler = None
+        self.sync_digest = None
+        if sync_enabled:
+            from ..models.constants import NODE_SYNC
+            from ..sync import InventoryDigest, Reconciler
+            digest = None
+            if hasattr(self.inventory, "attach_digest"):
+                self.sync_digest = InventoryDigest()
+                self.inventory.attach_digest(self.sync_digest)
+                digest = self.sync_digest
+            self.reconciler = Reconciler(self.pool, digest=digest)
+            self.pool.reconciler = self.reconciler
+            self.ctx.services |= NODE_SYNC
         if tls_enabled:
             # opportunistic NODE_SSL (reference tls.py); cert is
             # ephemeral and unverified — confidentiality only

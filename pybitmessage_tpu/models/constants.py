@@ -12,6 +12,9 @@ PROTOCOL_VERSION = 3
 NODE_NETWORK = 1
 NODE_SSL = 2
 NODE_DANDELION = 8
+# set-reconciliation inventory sync (docs/sync.md) — peers without the
+# bit stay on classic inv flooding
+NODE_SYNC = 16
 
 # object types
 OBJECT_GETPUBKEY = 0

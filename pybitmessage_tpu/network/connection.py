@@ -20,7 +20,7 @@ from typing import TYPE_CHECKING
 
 from ..models.constants import (
     MAGIC, MAX_MESSAGE_SIZE, MAX_OBJECT_COUNT, MAX_TIME_OFFSET,
-    NODE_DANDELION, NODE_SSL, PROTOCOL_VERSION,
+    NODE_DANDELION, NODE_SSL, NODE_SYNC, PROTOCOL_VERSION,
 )
 from ..models.objects import ObjectError, ObjectHeader, check_by_type
 from ..models.packet import (
@@ -345,8 +345,25 @@ class BMConnection:
             self._handshake_task = None
         self._anti_intersection_delay(initial=True)
         await self._send_addr_sample()
-        await self._send_big_inv()
+        if not await self._start_sync():
+            await self._send_big_inv()
         self.pool.connection_established(self)
+
+    async def _start_sync(self) -> bool:
+        """Negotiate set-reconciliation sync (docs/sync.md): when both
+        ends advertise NODE_SYNC and a reconciler is attached, register
+        the session and replace the big-inv flood with a digest-sized
+        IBLT catch-up.  The OUTBOUND end initiates (one exchange
+        converges both directions).  Returns False when the classic
+        big inv should be sent instead."""
+        rec = getattr(self.pool, "reconciler", None)
+        if rec is None or not self.services & NODE_SYNC \
+                or not self.ctx.services & NODE_SYNC:
+            return False
+        rec.register(self)
+        if not self.outbound:
+            return True
+        return await rec.start_catchup(self)
 
     async def _send_addr_sample(self) -> None:
         entries = []
@@ -401,6 +418,11 @@ class BMConnection:
             self._handle_inventory_announcement(h)
 
     def _handle_inventory_announcement(self, h: bytes) -> None:
+        rec = getattr(self.pool, "reconciler", None)
+        if rec is not None:
+            # the peer has this object: drop it from the sync pending
+            # set so neither a sketch nor an inv echoes it back
+            rec.peer_announced(self, h)
         if h in self.ctx.inventory:
             self.tracker.peer_announced(h)
             self.tracker.object_received(h)
@@ -532,6 +554,34 @@ class BMConnection:
             h, header.object_type, header.stream, payload, header.expires,
             tag)
         self.pool.object_received(h, header, payload, source=self)
+
+    # -- set-reconciliation sync (docs/sync.md) ------------------------------
+
+    def _reconciler(self):
+        rec = getattr(self.pool, "reconciler", None)
+        if rec is None or not rec.negotiated(self):
+            logger.debug("sync message from %s without a negotiated "
+                         "session; ignored", self.host)
+            return None
+        return rec
+
+    async def cmd_sketchreq(self, payload: bytes) -> None:
+        self._require_established()
+        rec = self._reconciler()
+        if rec is not None:
+            await rec.handle_sketchreq(self, payload)
+
+    async def cmd_sketch(self, payload: bytes) -> None:
+        self._require_established()
+        rec = self._reconciler()
+        if rec is not None:
+            await rec.handle_sketch(self, payload)
+
+    async def cmd_recondiff(self, payload: bytes) -> None:
+        self._require_established()
+        rec = self._reconciler()
+        if rec is not None:
+            await rec.handle_recondiff(self, payload)
 
     async def cmd_addr(self, payload: bytes) -> None:
         self._require_established()

@@ -164,6 +164,9 @@ class ConnectionPool:
         self._server: asyncio.AbstractServer | None = None
         self._tasks: list[asyncio.Task] = []
         self.on_object: Callable | None = None  # hook for the processor
+        #: set-reconciliation subsystem (docs/sync.md); None keeps the
+        #: classic flooding-only paths
+        self.reconciler = None
         #: LAN peers heard over UDP discovery -> last-heard time
         self.lan_peers: dict[Peer, float] = {}
         #: (AddrEntry, due_time) queue for ongoing addr relay
@@ -300,6 +303,8 @@ class ConnectionPool:
         self.outbound.pop(conn, None)
         CONNECTIONS.labels(direction="inbound").set(len(self.inbound))
         CONNECTIONS.labels(direction="outbound").set(len(self.outbound))
+        if self.reconciler is not None:
+            self.reconciler.unregister(conn)
         if self.ctx.dandelion:
             self.ctx.dandelion.remove_connection(conn)
         if conn.outbound and not conn.fully_established:
@@ -329,13 +334,28 @@ class ConnectionPool:
             Peer(entry.host, entry.port), entry.stream,
             lastseen=min(int(entry.time), int(time.time())))
 
+    def _route_announcement(self, h: bytes, conns) -> None:
+        """Fan one announcement out: stem-phase hashes always ride the
+        classic trackers (dandelion routing decides who may see them —
+        they must NEVER enter a reconciliation sketch), everything
+        else goes through the reconciler's flood/pending split when
+        sync is enabled."""
+        dand = self.ctx.dandelion
+        if self.reconciler is not None and \
+                (dand is None or not dand.in_stem_phase(h)):
+            self.reconciler.route_announcement(h, conns)
+            return
+        for conn in conns:
+            conn.tracker.we_should_announce(h)
+
     def object_received(self, h: bytes, header, payload: bytes,
                         source) -> None:
-        """A new valid object arrived: queue for processing + relay."""
+        """A new valid object arrived: queue for processing + relay.
+        The source connection is excluded — an inv must never echo
+        back to the peer that delivered the object."""
         OBJECTS_RECEIVED.inc()
-        for conn in self.established():
-            if conn is not source:
-                conn.tracker.we_should_announce(h)
+        self._route_announcement(
+            h, [c for c in self.established() if c is not source])
         self.ctx.object_queue.put_nowait((h, header, payload))
         if self.on_object is not None:
             self.on_object(h, header, payload, source)
@@ -348,8 +368,7 @@ class ConnectionPool:
         if local and dand and dand.enabled and \
                 random.randrange(100) < dand.stem_probability:
             dand.add_hash(h, stream, source=None)
-        for conn in self.established():
-            conn.tracker.we_should_announce(h)
+        self._route_announcement(h, self.established())
 
     # -- periodic tasks ------------------------------------------------------
 
@@ -445,7 +464,13 @@ class ConnectionPool:
         for conn in self.established():
             try:
                 await conn.send_packet("addr", packet)
-            except (ConnectionError, OSError):
+            except (ConnectionError, OSError) as exc:
+                # ongoing addr gossip is best-effort (the entries
+                # re-advertise through other peers), but the failed
+                # send must be COUNTED, not silently swallowed
+                ERRORS.labels(site="net.send").inc()
+                logger.debug("addr gossip to %s failed: %r",
+                             conn.host, exc)
                 continue
 
     async def _inv_once(self) -> None:
@@ -453,8 +478,11 @@ class ConnectionPool:
         dand = self.ctx.dandelion
         if dand:
             for h, stream in dand.expire_fluffed():
-                for conn in self.established():
-                    conn.tracker.we_should_announce(h)
+                # stem timer expired: the hash is now an ordinary
+                # fluff announcement and may use the sync paths
+                self._route_announcement(h, self.established())
+        if self.reconciler is not None:
+            await self.reconciler.tick()
         for conn in self.established():
             chunk = conn.tracker.take_announcements()
             if not chunk:

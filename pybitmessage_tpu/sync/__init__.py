@@ -1,0 +1,37 @@
+"""Set-reconciliation sync subsystem (docs/sync.md).
+
+Replaces most per-object inv flooding with periodic per-peer sketch
+exchanges (Erlay, Naumenko et al. CCS 2019; Graphene, Ozisik et al.
+SIGCOMM 2019 — see PAPERS.md):
+
+- :mod:`.sketch` — an invertible Bloom lookup table (IBLT) over
+  salted 64-bit short IDs of inventory hashes, with ``encode`` /
+  ``subtract`` / ``decode`` (peeling) and capacity estimation;
+  vectorized with numpy when available, pure-Python otherwise;
+- :mod:`.digest` — bucketed inventory digests maintained
+  incrementally by ``storage/inventory.py`` so initial-sync catch-up
+  of a freshly-connected peer never rescans the inventory table;
+- :mod:`.reconciler` — the per-connection session state machine
+  (init -> sketch -> diff -> getdata) with a circuit breaker that
+  degrades failing peers back to classic inv flooding, and the
+  low-fanout hybrid: new objects still flood to a small sqrt(n)
+  subset of peers for latency, everyone else reconciles;
+- :mod:`.mesh` — an in-process simulated peer mesh driving the real
+  reconciler/codec stack, used by ``bench.py sync_storm`` and the
+  chaos suite.
+
+Everything reports through ``observability.REGISTRY`` and plants the
+``sync.sketch_decode`` chaos site (docs/resilience.md).
+"""
+
+from .digest import DIGEST_BUCKETS, InventoryDigest
+from .reconciler import Reconciler, SyncSession
+from .sketch import (Sketch, SketchDecodeError, capacity_for, short_id,
+                     short_id_map, short_ids)
+
+__all__ = [
+    "Sketch", "SketchDecodeError", "capacity_for",
+    "short_id", "short_ids", "short_id_map",
+    "InventoryDigest", "DIGEST_BUCKETS",
+    "Reconciler", "SyncSession",
+]

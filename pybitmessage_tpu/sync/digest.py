@@ -1,0 +1,145 @@
+"""Bucketed inventory digests for initial-sync catch-up.
+
+When a sync-capable peer connects, both sides exchange per-stream
+bucket summaries — ``(count, xor-of-short-ids)`` per bucket — instead
+of the reference's big-inv flood of every unexpired hash
+(tcp.py:210-253).  Buckets whose summaries match cost ~12 bytes and
+announce nothing; only mismatched buckets fall back to explicit inv
+lists.  Two already-synced nodes meet for a few hundred bytes instead
+of megabytes.
+
+The digest is maintained *incrementally* by ``storage/inventory.py``
+(``attach_digest``): ``add`` folds the new hash in, ``clean`` unfolds
+expired ones — XOR makes removal exact — so reconciliation rounds and
+catch-ups never rescan the inventory table (regression-guarded in
+tests/test_sync.py).
+
+Digest short IDs use a FIXED zero salt: the summaries are maintained
+once per node, not per session, so every peer must bucket and mix
+identically.  The per-session salting that protects IBLT rounds from
+collision grinding does not apply here; a ground collision merely
+makes one bucket compare unequal (cost: one bucket's inv list).
+"""
+
+from __future__ import annotations
+
+import threading
+
+from .sketch import short_id
+
+#: buckets per stream; hash -> bucket via its first byte
+DIGEST_BUCKETS = 64
+#: the session-independent salt digest IDs are mixed with
+DIGEST_SALT = 0
+
+
+def bucket_of(hash_: bytes, buckets: int = DIGEST_BUCKETS) -> int:
+    return hash_[0] % buckets
+
+
+class InventoryDigest:
+    """Incremental per-stream bucket summaries over unexpired hashes."""
+
+    def __init__(self, buckets: int = DIGEST_BUCKETS):
+        self.buckets = buckets
+        self._lock = threading.RLock()
+        #: hash -> (stream, expires, short_id) — exact removal support
+        self._entries: dict[bytes, tuple[int, int, int]] = {}
+        #: stream -> ([count]*buckets, [xor]*buckets)
+        self._streams: dict[int, tuple[list[int], list[int]]] = {}
+        #: digests served without an inventory rescan (metrics/tests)
+        self.incremental_updates = 0
+
+    def _tables(self, stream: int) -> tuple[list[int], list[int]]:
+        t = self._streams.get(stream)
+        if t is None:
+            t = self._streams[stream] = ([0] * self.buckets,
+                                         [0] * self.buckets)
+        return t
+
+    # -- incremental maintenance (storage/inventory.py hooks) ----------------
+
+    def add(self, hash_: bytes, stream: int, expires: int) -> None:
+        with self._lock:
+            if hash_ in self._entries:
+                return
+            sid = short_id(hash_, DIGEST_SALT)
+            self._entries[hash_] = (stream, expires, sid)
+            counts, xors = self._tables(stream)
+            b = bucket_of(hash_, self.buckets)
+            counts[b] += 1
+            xors[b] ^= sid
+            self.incremental_updates += 1
+
+    def discard(self, hash_: bytes) -> None:
+        with self._lock:
+            entry = self._entries.pop(hash_, None)
+            if entry is None:
+                return
+            stream, _, sid = entry
+            counts, xors = self._tables(stream)
+            b = bucket_of(hash_, self.buckets)
+            counts[b] -= 1
+            xors[b] ^= sid
+            self.incremental_updates += 1
+
+    def clean(self, now: int) -> int:
+        """Unfold entries expired at ``now``; returns how many left.
+        Expired objects must stop being announced even while the SQL
+        table still holds them inside its 3 h purge grace."""
+        with self._lock:
+            stale = [h for h, (_, exp, _) in self._entries.items()
+                     if exp <= now]
+            for h in stale:
+                self.discard(h)
+            return len(stale)
+
+    def rebuild(self, seed) -> None:
+        """(Re)build from ``(hash, stream, expires)`` triples — the one
+        full scan, paid at attach time only."""
+        with self._lock:
+            self._entries.clear()
+            self._streams.clear()
+            for hash_, stream, expires in seed:
+                self.add(hash_, stream, expires)
+            self.incremental_updates = 0
+
+    # -- queries -------------------------------------------------------------
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def __contains__(self, hash_: bytes) -> bool:
+        with self._lock:
+            return hash_ in self._entries
+
+    def summaries(self, stream: int) -> list[tuple[int, int]]:
+        """``(count, xor)`` per bucket for one stream."""
+        with self._lock:
+            counts, xors = self._tables(stream)
+            return list(zip(counts, xors))
+
+    def mismatched_buckets(self, stream: int,
+                           remote: list[tuple[int, int]]) -> list[int]:
+        """Bucket indices whose summaries differ from a peer's.  A
+        remote summary with a different bucket count is entirely
+        incomparable — every bucket mismatches."""
+        with self._lock:
+            local = self.summaries(stream)
+            if len(remote) != len(local):
+                return list(range(self.buckets))
+            return [i for i, (mine, theirs) in
+                    enumerate(zip(local, remote)) if mine != theirs]
+
+    def hashes_in_buckets(self, stream: int,
+                          buckets: "set[int] | list[int]") -> list[bytes]:
+        wanted = set(buckets)
+        with self._lock:
+            return [h for h, (s, _, _) in self._entries.items()
+                    if s == stream and bucket_of(h, self.buckets) in wanted]
+
+    def hashes_by_stream(self, stream: int) -> list[bytes]:
+        with self._lock:
+            return [h for h, (s, _, _) in self._entries.items()
+                    if s == stream]

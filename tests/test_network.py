@@ -84,6 +84,43 @@ def test_inv_codec():
     assert decode_inv(encode_inv(hashes)) == hashes
 
 
+def test_inv_codec_empty():
+    assert decode_inv(encode_inv([])) == []
+    assert encode_inv([]) == b"\x00"
+
+
+def test_inv_codec_exactly_at_protocol_maximum():
+    from pybitmessage_tpu.models.constants import MAX_INV_COUNT
+
+    hashes = [i.to_bytes(32, "big") for i in range(MAX_INV_COUNT)]
+    out = decode_inv(encode_inv(hashes))
+    assert len(out) == MAX_INV_COUNT
+    assert out[0] == hashes[0] and out[-1] == hashes[-1]
+    # the encoder silently truncates one-past-maximum input rather
+    # than emitting an overlong (peer-disconnecting) packet
+    over = hashes + [b"\xff" * 32]
+    assert len(decode_inv(encode_inv(over))) == MAX_INV_COUNT
+
+
+def test_inv_codec_one_past_maximum_raises():
+    from pybitmessage_tpu.models.constants import MAX_INV_COUNT
+    from pybitmessage_tpu.network.messages import MessageError
+    from pybitmessage_tpu.utils.varint import encode_varint
+
+    # a hand-rolled count of MAX+1 must be refused BEFORE any length
+    # check touches the (absent) hash bytes
+    with pytest.raises(MessageError):
+        decode_inv(encode_varint(MAX_INV_COUNT + 1))
+
+
+def test_inv_codec_truncated_payload_raises():
+    from pybitmessage_tpu.network.messages import MessageError
+    from pybitmessage_tpu.utils.varint import encode_varint
+
+    with pytest.raises(MessageError):
+        decode_inv(encode_varint(2) + b"\x00" * 63)  # one byte short
+
+
 def test_network_group_antisybil():
     assert network_group("1.2.3.4") == network_group("1.2.9.9")
     assert network_group("1.2.3.4") != network_group("1.3.3.4")

@@ -338,7 +338,7 @@ def test_no_silent_exception_swallows():
             isinstance(stmt.value, ast.Constant)
 
     offenders = []
-    for pkg in ("pow", "network"):
+    for pkg in ("pow", "network", "sync"):
         for path in sorted((root / pkg).glob("*.py")):
             tree = ast.parse(path.read_text(), filename=str(path))
             for node in ast.walk(tree):
@@ -366,6 +366,7 @@ def test_metric_naming_conventions():
             "pybitmessage_tpu.network.pool",
             "pybitmessage_tpu.storage.inventory",
             "pybitmessage_tpu.storage.writebehind",
+            "pybitmessage_tpu.sync.reconciler",
             "pybitmessage_tpu.utils.queues",
             "pybitmessage_tpu.workers.cryptopool",
             "pybitmessage_tpu.workers.sender",
