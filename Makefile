@@ -2,7 +2,7 @@
 # build + tox targets).  The C++ solver is also auto-built at runtime by
 # pybitmessage_tpu/pow/native.py when missing or stale.
 
-.PHONY: all native test bench bench-smoke chaos clean
+.PHONY: all native test bench bench-smoke chaos perfguard clean
 
 all: native
 
@@ -30,6 +30,15 @@ chaos: native
 # zero object loss (docs/sync.md) or the run fails
 bench-smoke:
 	JAX_PLATFORMS=cpu python bench.py --smoke
+
+# perf guard (docs/observability.md): run bench-smoke and diff the
+# guarded metrics against the committed baseline with per-metric
+# tolerance bands — exits non-zero on regression, keeping the
+# BENCH_r01->r05 gains from silently eroding.  Re-baseline after an
+# intentional perf change with:
+#   python tools/bench_compare.py --run --update
+perfguard:
+	python tools/bench_compare.py --run
 
 clean:
 	$(MAKE) -C native/pow clean

@@ -715,17 +715,12 @@ def _bench_ingest_storm(identities: int = 8, objects: int = 400,
             crypto=CryptoPool() if pipelined else CryptoPool(size=0),
             concurrency=8 if pipelined else 1,
             write_behind=pipelined)
-        lag = {"max": 0.0}
-        done = asyncio.Event()
-
-        async def probe():
-            loop = asyncio.get_running_loop()
-            while not done.is_set():
-                t0 = loop.time()
-                await asyncio.sleep(0.005)
-                lag["max"] = max(lag["max"], loop.time() - t0 - 0.005)
-
-        prober = asyncio.create_task(probe())
+        # the promoted always-on sampler (observability/health.py) at
+        # the old probe's 5 ms cadence; it ALSO feeds the exported
+        # event_loop_lag_seconds histogram
+        from pybitmessage_tpu.observability import LoopLagProbe
+        prober = LoopLagProbe(0.005)
+        prober.start()
         proc.start()
         t0 = time.perf_counter()
         for p in payloads:
@@ -734,15 +729,14 @@ def _bench_ingest_storm(identities: int = 8, objects: int = 400,
             await asyncio.sleep(0.002)
         await proc.stop()       # final write-behind drain is in-scope
         dt = max(time.perf_counter() - t0, 1e-9)
-        done.set()
-        await prober
+        await prober.stop()
         delivered = len(store.inbox())
         db.close()
         return {
             "wall_s": round(dt, 3),
             "objects_per_s": round(len(payloads) / dt, 1),
             "delivered": delivered,
-            "max_loop_lag_ms": round(lag["max"] * 1e3, 2),
+            "max_loop_lag_ms": round(prober.max_lag * 1e3, 2),
         }
 
     pipe = asyncio.run(run(True))
@@ -864,6 +858,11 @@ def _bench_sync_storm(peers: int = 8, objects: int = 10000,
 
     ratio = flood.stats.announce_bytes / max(
         sync.stats.announce_bytes, 1)
+    # cross-node propagation latency (ISSUE 6): per-mesh lifecycle
+    # tracers stamp injection and observe every delivery at another
+    # node; one mesh tick == one simulated second
+    prop_sync = sync.lifecycle.propagation_percentiles()
+    prop_flood = flood.lifecycle.propagation_percentiles()
     out = {
         "peers": peers, "objects": objects,
         "seeded_overlap": 1.0 - missing_frac, "live_injected": live,
@@ -880,8 +879,14 @@ def _bench_sync_storm(peers: int = 8, objects: int = 10000,
         "sync_extra_convergence_ticks": extra_ticks,
         "diff_p90": round((REGISTRY.get("sync_diff_size") or
                            _NullHist()).percentile(0.9), 1),
+        "propagation_ticks": {"reconciliation": prop_sync,
+                              "flooding": prop_flood},
     }
     if not smoke:
+        # acceptance (ISSUE 6): the propagation percentiles the
+        # scenario lab is built on must actually be measured
+        assert prop_sync is not None and prop_sync["count"] > 0, (
+            "sync mesh recorded no propagation latencies")
         # acceptance: >=5x announcement-bandwidth reduction, no loss
         assert ratio >= 5.0, (
             "sync reduced announce bytes only %.2fx (need >=5x)" % ratio)

@@ -119,6 +119,11 @@ async def run(args) -> int:
     node.settings = settings
     node.dandelion.stem_probability = settings.getint("dandelion")
     node.processor.list_mode = settings.get("blackwhitelist")
+    # observability knobs (docs/observability.md)
+    from .observability import FLIGHT_RECORDER
+    FLIGHT_RECORDER.resize(settings.getint("flightrecsize"))
+    node.health.sample_interval = settings.getfloat("healthinterval")
+    node.health.probe.interval = settings.getfloat("looplaginterval")
     # ingest fast path knobs (docs/ingest.md) — applied before start()
     # spawns the pipeline workers
     node.processor.concurrency = settings.getint("ingestworkers")
@@ -354,6 +359,13 @@ def main(argv=None) -> int:
         return asyncio.run(run(args))
     except KeyboardInterrupt:  # pragma: no cover
         return 0
+    except Exception:
+        # fatal: dump the flight recorder — the ring holds the
+        # breaker/chaos/slab/sync event trail of the seconds before
+        # death, which is exactly what the post-mortem needs
+        from .observability import FLIGHT_RECORDER
+        FLIGHT_RECORDER.dump("fatal")
+        raise
     finally:
         if lock is not None:
             lock.release()

@@ -731,6 +731,29 @@ class CommandHandler:
         from ..observability import render_prometheus
         return render_prometheus()
 
+    def cmd_dumpFlightRecorder(self, kind=""):
+        """Dump the flight-recorder ring (ISSUE 6): the last N
+        structured events — breaker flips, chaos fires, ladder
+        fallbacks, sync round verdicts, slab traffic, watermark
+        pauses — newest last.  Also emits the dump as one structured
+        log line (trigger=api).  Optional ``kind`` filters by event
+        kind."""
+        from ..observability import FLIGHT_RECORDER
+        events = FLIGHT_RECORDER.dump("api")
+        if kind:
+            events = [e for e in events if e.get("kind") == kind]
+        return json.dumps({"events": events}, default=repr)
+
+    def cmd_objectTimeline(self, hash_hex):
+        """Lifecycle timeline of one inventory hash: the recorded
+        stage events (received/parsed/decrypted/verified/stored/
+        announced/sync_pushed/delivered), oldest first."""
+        if len(hash_hex) != 64:
+            raise APIError(19)
+        from ..observability import LIFECYCLE
+        return json.dumps(
+            {"timeline": LIFECYCLE.timeline(unhexlify(hash_hex))})
+
     def _pow_stats(self) -> dict:
         """Per-tier PoW stats for clientStatus, read from the metrics
         registry (solve counts + trials per backend, fallbacks, batch
@@ -804,6 +827,21 @@ class CommandHandler:
             "chaos": CHAOS.active(),
         }
 
+    def _health_stats(self) -> dict:
+        """Composite per-subsystem health block (ISSUE 6): each
+        subsystem answers ok/degraded with the reading that tripped
+        it — loop lag, pow breakers/queue, ingest watermarks and
+        worker saturation, write-behind backlog, sync breakers."""
+        health = getattr(self.node, "health", None)
+        if health is None:
+            from ..observability import HealthMonitor
+            health = HealthMonitor(self.node)
+        return health.health_block()
+
+    def _lifecycle_stats(self) -> dict:
+        from ..observability import LIFECYCLE
+        return LIFECYCLE.snapshot()
+
     def cmd_clientStatus(self):
         pool = self.node.pool
         established = len(pool.established())
@@ -859,6 +897,12 @@ class CommandHandler:
             "powStats": self._pow_stats(),
             # failure-path health: breaker/stall/journal state (ISSUE 3)
             "resilience": self._resilience_stats(),
+            # composite per-subsystem health verdicts + loop lag
+            # (ISSUE 6; observability/health.py)
+            "health": self._health_stats(),
+            # lifecycle tracer summary: retained timelines, per-stage
+            # event counts, propagation percentiles when measured
+            "lifecycle": self._lifecycle_stats(),
             "powVerify": {
                 "host": getattr(self.node.pow_verifier, "host_checked", 0),
                 "device": getattr(self.node.pow_verifier,

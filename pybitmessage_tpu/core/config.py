@@ -109,6 +109,13 @@ DEFAULTS: dict[str, str] = {
     "chaos": "",                     # fault-injection spec, e.g.
                                      # "pow.device_launch:0.5,db.write:1x3"
     "chaosseed": "0",                # deterministic chaos seed
+    # -- observability (docs/observability.md) --
+    "flightrecsize": "512",          # flight-recorder ring capacity
+                                     # (events)
+    "healthinterval": "5",           # health-gauge sampling cadence,
+                                     # seconds
+    "looplaginterval": "0.25",       # event-loop lag probe cadence,
+                                     # seconds
     "blackwhitelist": "black",       # inbound sender policy
     # ceilings on recipient-demanded PoW; 0 = unlimited (reference
     # helper_startup sanity cap: ridiculousDifficulty x network default)
@@ -173,6 +180,9 @@ VALIDATORS: dict[str, Callable[[str], bool]] = {
     "connecttimeout": _validate_float_range(1.0, 300.0),
     "handshaketimeout": _validate_float_range(1.0, 3600.0),
     "chaosseed": _validate_int_range(0, 2**63 - 1),
+    "flightrecsize": _validate_int_range(16, 1 << 20),
+    "healthinterval": _validate_float_range(0.1, 3600.0),
+    "looplaginterval": _validate_float_range(0.01, 60.0),
     "apienabled": _validate_bool,
     "notifysound": _validate_bool,
     "smtpdenabled": _validate_bool,

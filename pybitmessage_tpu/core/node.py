@@ -153,6 +153,11 @@ class Node:
             self.udp = UDPDiscovery(self.pool)
         self._pump_task: asyncio.Task | None = None
         self._metrics_task: asyncio.Task | None = None
+        #: always-on runtime health probes (ISSUE 6): event-loop lag
+        #: sampler + worker-saturation gauges + the composite
+        #: per-subsystem block clientStatus serves
+        from ..observability import HealthMonitor
+        self.health = HealthMonitor(self)
 
     def _solve(self, initial_hash, target, should_stop=None):
         return self.solver(initial_hash, target, should_stop=should_stop)
@@ -174,6 +179,7 @@ class Node:
         # JSON line per minute covering only metrics that changed
         from ..observability import log_snapshot_task
         self._metrics_task = asyncio.create_task(log_snapshot_task(60.0))
+        self.health.start()
         logger.info("node started (port %s)",
                     self.pool.listen_port if self.listen else "-")
 
@@ -186,6 +192,7 @@ class Node:
     async def stop(self) -> None:
         """Orderly shutdown (reference shutdown.py:19-91)."""
         self.shutdown.set()
+        await self.health.stop()
         if self._pump_task:
             self._pump_task.cancel()
         if self._metrics_task:

@@ -18,6 +18,7 @@ from collections import deque
 from typing import Callable, Optional
 
 from ..observability import REGISTRY
+from ..observability.lifecycle import LIFECYCLE
 from ..resilience import CircuitBreaker, inject
 from ..resilience.policy import ERRORS
 from ..storage.knownnodes import Peer
@@ -340,6 +341,7 @@ class ConnectionPool:
         they must NEVER enter a reconciliation sketch), everything
         else goes through the reconciler's flood/pending split when
         sync is enabled."""
+        LIFECYCLE.record(h, "announced")
         dand = self.ctx.dandelion
         if self.reconciler is not None and \
                 (dand is None or not dand.in_stem_phase(h)):
@@ -354,6 +356,7 @@ class ConnectionPool:
         The source connection is excluded — an inv must never echo
         back to the peer that delivered the object."""
         OBJECTS_RECEIVED.inc()
+        LIFECYCLE.record(h, "received")
         self._route_announcement(
             h, [c for c in self.established() if c is not source])
         self.ctx.object_queue.put_nowait((h, header, payload))

@@ -1,9 +1,14 @@
-"""Process-wide telemetry: metrics registry, span tracer, exporters.
+"""Process-wide telemetry: metrics registry, span tracer, exporters,
+object lifecycle tracing, flight recorder, runtime health probes.
 
 See docs/observability.md for the full catalog of exported metrics.
 """
 
-from .export import log_snapshot_task, render_prometheus, snapshot
+from .export import (escape_help, escape_label_value, log_snapshot_task,
+                     render_prometheus, snapshot)
+from .flightrec import FLIGHT_RECORDER, FlightRecorder
+from .health import HealthMonitor, LoopLagProbe
+from .lifecycle import LIFECYCLE, LifecycleTracer
 from .metrics import (DEFAULT_LATENCY_BUCKETS, DEFAULT_SIZE_BUCKETS,
                       REGISTRY, Counter, Gauge, Histogram, Registry)
 from .tracing import (TRACER, Span, Tracer, current_span,
@@ -16,4 +21,8 @@ __all__ = [
     "Span", "Tracer", "TRACER", "trace", "current_span",
     "enable_jax_annotations", "jax_annotations_enabled",
     "render_prometheus", "snapshot", "log_snapshot_task",
+    "escape_help", "escape_label_value",
+    "LifecycleTracer", "LIFECYCLE",
+    "FlightRecorder", "FLIGHT_RECORDER",
+    "HealthMonitor", "LoopLagProbe",
 ]

@@ -18,7 +18,9 @@ import asyncio
 import json
 import logging
 
-from .metrics import REGISTRY, Counter, Gauge, Histogram, Registry
+from .metrics import (REGISTRY, Counter, Gauge,  # noqa: F401 — public
+                      Histogram, Registry, escape_help,
+                      escape_label_value)
 
 logger = logging.getLogger("pybitmessage_tpu.observability")
 

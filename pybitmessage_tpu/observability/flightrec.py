@@ -1,0 +1,111 @@
+"""Flight recorder: a fixed-size ring of structured events (ISSUE 6).
+
+The black box for post-mortems.  Subsystems append one small event per
+*notable* transition — breaker state changes, chaos injections, solver
+ladder fallbacks, sync round verdicts, slab launches/harvests,
+ingest-watermark pause/resume, PoW requeues — and the ring keeps the
+last ``maxlen`` of them.  When something dies, the seconds BEFORE the
+death are what explain it:
+
+- :class:`~pybitmessage_tpu.resilience.watchdog.StallGuard` dumps the
+  ring automatically when it detects a stalled launch;
+- the daemon entry point dumps it on a fatal (unhandled) error;
+- the ``dumpFlightRecorder`` API command dumps it on demand.
+
+Appends are lock-free on CPython: one ``deque.append`` (atomic under
+the GIL) plus a counter increment — cheap enough for per-slab cadence.
+``record()`` never raises.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import logging
+import time
+from collections import deque
+
+from .metrics import REGISTRY
+
+logger = logging.getLogger("pybitmessage_tpu.observability")
+
+EVENTS = REGISTRY.counter(
+    "flightrec_events_total",
+    "Structured events appended to the flight-recorder ring",
+    ("kind",))
+DUMPS = REGISTRY.counter(
+    "flightrec_dumps_total",
+    "Flight-recorder dumps by trigger (stall/fatal/api)", ("trigger",))
+
+#: default ring capacity (events, not bytes); overridable via the
+#: ``flightrecsize`` setting
+DEFAULT_CAPACITY = 512
+
+
+class FlightRecorder:
+    """Ring buffer of ``{kind, t, seq, **fields}`` event dicts."""
+
+    def __init__(self, maxlen: int = DEFAULT_CAPACITY):
+        self._ring: deque = deque(maxlen=max(1, maxlen))
+        #: itertools.count — __next__ is atomic under the GIL, unlike
+        #: a += on an int attribute (record() runs on the event loop
+        #: AND from dispatcher/watchdog threads)
+        self._seq = itertools.count(1)
+        self.enabled = True
+
+    def resize(self, maxlen: int) -> None:
+        """Re-cap the ring, keeping the newest events."""
+        maxlen = max(1, maxlen)
+        self._ring = deque(list(self._ring)[-maxlen:], maxlen=maxlen)
+
+    # -- recording (hot path: must never raise) ------------------------------
+
+    def record(self, kind: str, **fields) -> None:
+        if not self.enabled:
+            return
+        try:
+            event = {"kind": kind, "t": round(time.time(), 4),
+                     "seq": next(self._seq)}
+            event.update(fields)
+            self._ring.append(event)
+            EVENTS.labels(kind=kind).inc()
+        except Exception:  # pragma: no cover — telemetry never kills
+            logger.debug("flight recorder append failed", exc_info=True)
+
+    # -- reading / dumping ---------------------------------------------------
+
+    def events(self, n: int | None = None,
+               kind: str | None = None) -> list[dict]:
+        """Newest-last slice of the ring (optionally filtered)."""
+        out = list(self._ring)
+        if kind is not None:
+            out = [e for e in out if e.get("kind") == kind]
+        return out[-n:] if n else out
+
+    def clear(self) -> None:
+        self._ring.clear()
+
+    def dump(self, trigger: str, *, log: logging.Logger | None = None
+             ) -> list[dict]:
+        """Emit the whole ring as one structured log line and return
+        the events.  ``trigger`` names why (stall/fatal/api) — every
+        dump is counted so post-mortems know whether the black box
+        fired at all."""
+        events = self.events()
+        DUMPS.labels(trigger=trigger).inc()
+        try:
+            (log or logger).warning(
+                "flightrec_dump trigger=%s events=%d %s", trigger,
+                len(events), json.dumps(events, default=repr))
+        except Exception:  # pragma: no cover
+            logger.exception("flight recorder dump failed")
+        return events
+
+
+#: the process-wide ring every subsystem hook appends to
+FLIGHT_RECORDER = FlightRecorder()
+
+
+def record(kind: str, **fields) -> None:
+    """Module-level shorthand for ``FLIGHT_RECORDER.record``."""
+    FLIGHT_RECORDER.record(kind, **fields)

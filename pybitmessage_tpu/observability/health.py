@@ -1,0 +1,219 @@
+"""Runtime health probes: loop lag, worker saturation, health block.
+
+Promotes bench.py's ad-hoc loop-lag probe to an always-on sampler
+(ISSUE 6): :class:`LoopLagProbe` sleeps ``interval`` seconds on the
+event loop and feeds how late it woke into the
+``event_loop_lag_seconds`` histogram — the single most diagnostic
+number for "the node feels stuck" (crypto or SQL leaked onto the
+loop, a flood starved it, the process is swapping).
+
+:class:`HealthMonitor` owns the probe plus a slow sampling tick that
+refreshes saturation gauges (crypto-pool backlog, ingest-worker
+occupancy) and serves the composite per-subsystem ``health`` block
+``clientStatus`` exposes: each subsystem reports ``ok`` or
+``degraded`` with the reading that tripped it, so a glance answers
+*which layer* is sick before anyone reads raw metric families.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from collections import deque
+
+from .metrics import REGISTRY
+
+logger = logging.getLogger("pybitmessage_tpu.observability")
+
+LOOP_LAG = REGISTRY.histogram(
+    "event_loop_lag_seconds",
+    "How late the health sampler's sleep woke up — event-loop "
+    "scheduling delay (always-on promotion of the bench probe)")
+LOOP_LAG_MAX = REGISTRY.gauge(
+    "event_loop_lag_max_seconds",
+    "Worst loop lag observed since process start")
+CRYPTO_SATURATION = REGISTRY.gauge(
+    "crypto_pool_saturation",
+    "Queued crypto-pool work items per worker thread (0 = idle)")
+INGEST_SATURATION = REGISTRY.gauge(
+    "ingest_worker_saturation",
+    "Fraction of ingest pipeline workers mid-object (1.0 = all busy)")
+
+#: default probe cadence, seconds — coarse enough to cost nothing,
+#: fine enough that a multi-second stall is caught within one tick
+DEFAULT_INTERVAL = 0.25
+
+#: loop-lag threshold above which the loop subsystem reports degraded
+#: (same budget the ingest bench asserts)
+LAG_DEGRADED_SECONDS = 0.05
+
+
+class LoopLagProbe:
+    """Asyncio task measuring event-loop scheduling delay.
+
+    ``await asyncio.sleep(interval)`` should resume ``interval``
+    seconds later; any excess is time the loop spent running other
+    callbacks (or blocked in C) — the lag.
+    """
+
+    #: samples kept for the live-state window (~1 min at the default
+    #: cadence) — the health verdict must reflect the loop NOW, not a
+    #: since-start histogram a day of healthy samples has diluted
+    WINDOW = 240
+
+    def __init__(self, interval: float = DEFAULT_INTERVAL, *,
+                 histogram=LOOP_LAG):
+        self.interval = interval
+        self.histogram = histogram
+        self.max_lag = 0.0
+        self.recent: deque = deque(maxlen=self.WINDOW)
+        self._task: asyncio.Task | None = None
+
+    async def run(self) -> None:
+        loop = asyncio.get_running_loop()
+        while True:
+            t0 = loop.time()
+            await asyncio.sleep(self.interval)
+            lag = max(loop.time() - t0 - self.interval, 0.0)
+            self.recent.append(lag)
+            if self.histogram is not None:
+                self.histogram.observe(lag)
+            if lag > self.max_lag:
+                self.max_lag = lag
+                LOOP_LAG_MAX.set(lag)
+
+    def recent_p99(self) -> float:
+        """p99 over the recent window (0.0 with no samples yet)."""
+        if not self.recent:
+            return 0.0
+        lags = sorted(self.recent)
+        return lags[min(int(0.99 * len(lags)), len(lags) - 1)]
+
+    def start(self) -> asyncio.Task:
+        self._task = asyncio.create_task(self.run())
+        return self._task
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+
+
+class HealthMonitor:
+    """Always-on probes + the composite clientStatus health block."""
+
+    def __init__(self, node=None, *, lag_interval: float = DEFAULT_INTERVAL,
+                 sample_interval: float = 5.0):
+        self.node = node
+        self.probe = LoopLagProbe(lag_interval)
+        self.sample_interval = sample_interval
+        self._tasks: list[asyncio.Task] = []
+
+    def start(self) -> None:
+        self._tasks = [self.probe.start(),
+                       asyncio.create_task(self._sample_loop())]
+
+    async def stop(self) -> None:
+        await self.probe.stop()
+        for t in self._tasks[1:]:
+            t.cancel()
+        if self._tasks[1:]:
+            await asyncio.gather(*self._tasks[1:], return_exceptions=True)
+        self._tasks = []
+
+    # -- sampling ------------------------------------------------------------
+
+    async def _sample_loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.sample_interval)
+            try:
+                self.sample()
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                logger.debug("health sample failed", exc_info=True)
+
+    def sample(self) -> None:
+        """Refresh the saturation gauges from live node state."""
+        node = self.node
+        if node is None:
+            return
+        proc = getattr(node, "processor", None)
+        if proc is not None:
+            workers = max(getattr(proc, "concurrency", 1), 1)
+            INGEST_SATURATION.set(
+                min(getattr(proc, "active", 0) / workers, 1.0))
+            pool = getattr(proc, "crypto", None)
+            if pool is not None:
+                CRYPTO_SATURATION.set(_crypto_backlog_per_worker(pool))
+
+    # -- the composite block -------------------------------------------------
+
+    def health_block(self) -> dict:
+        """Per-subsystem health for ``clientStatus``."""
+        node = self.node
+        out: dict = {}
+
+        # windowed, not the since-start histogram: the verdict must
+        # flip when the loop wedges NOW, not 15 minutes later
+        lag_p99 = self.probe.recent_p99()
+        out["loop"] = _verdict(
+            lag_p99 <= LAG_DEGRADED_SECONDS,
+            lagP99Ms=round(lag_p99 * 1e3, 2),
+            lagMaxMs=round(self.probe.max_lag * 1e3, 2))
+
+        if node is None:
+            return out
+
+        # pow: queue depth + any open breaker
+        from ..resilience.policy import BREAKERS
+        open_breakers = [n for n, b in BREAKERS.items()
+                         if not b.available()]
+        depth = int(REGISTRY.sample("pow_queue_depth"))
+        out["pow"] = _verdict(
+            not open_breakers,
+            queueDepth=depth, openBreakers=open_breakers)
+
+        # ingest: queue depth vs watermark, worker saturation
+        queue = getattr(getattr(node, "ctx", None), "object_queue", None)
+        paused = bool(getattr(queue, "paused", False))
+        out["ingest"] = _verdict(
+            not paused,
+            queueDepth=queue.qsize() if queue is not None else 0,
+            paused=paused,
+            workerSaturation=round(INGEST_SATURATION.value, 3),
+            cryptoBacklog=round(CRYPTO_SATURATION.value, 2))
+
+        # storage: write-behind backlog (direct stores report 0)
+        wb = getattr(getattr(node, "processor", None), "_wb", None)
+        pending = wb.pending_rows() if wb is not None else 0
+        out["storage"] = _verdict(
+            wb is None or pending < wb.max_rows, pendingRows=pending)
+
+        # sync: sessions with an open breaker are degraded peers
+        recon = getattr(node, "reconciler", None)
+        if recon is not None:
+            snap = recon.snapshot_state()
+            out["sync"] = _verdict(
+                snap["breakersOpen"] == 0, **snap)
+        return out
+
+
+def _verdict(ok: bool, **detail) -> dict:
+    return {"status": "ok" if ok else "degraded", **detail}
+
+
+def _crypto_backlog_per_worker(pool) -> float:
+    """Queued work per crypto worker; inline pools (size=0) read 0."""
+    size = max(getattr(pool, "size", 0), 0)
+    ex = getattr(pool, "_exec", None)
+    if not size or ex is None:
+        return 0.0
+    try:
+        return ex._work_queue.qsize() / size
+    except Exception:  # pragma: no cover — executor internals moved
+        return 0.0

@@ -24,11 +24,14 @@ unit suffix (``_seconds``, ``_bytes``, ``_size``).
 
 from __future__ import annotations
 
+import logging
 import math
 import re
 import threading
 from bisect import bisect_left
 from typing import Iterable
+
+logger = logging.getLogger("pybitmessage_tpu.observability")
 
 _NAME_RE = re.compile(r"^[a-z][a-z0-9_]*$")
 _LABEL_RE = re.compile(r"^[a-z][a-z0-9_]*$")
@@ -43,7 +46,10 @@ DEFAULT_SIZE_BUCKETS = tuple(float(1 << i) for i in range(11))
 
 #: refuse to materialize more label sets than this per family — a
 #: mis-labeled hot path (e.g. a peer address used as a label) would
-#: otherwise grow memory without bound
+#: otherwise grow memory without bound.  Excess label sets are DROPPED
+#: (recorded into a shared unrendered overflow child and counted in
+#: ``observability_dropped_series_total``), never raised: telemetry
+#: must not crash the hot path it observes.
 MAX_LABEL_SETS = 512
 
 
@@ -57,14 +63,23 @@ def _fmt(v: float) -> str:
     return repr(float(v))
 
 
-def _escape(value: str) -> str:
+def escape_label_value(value: str) -> str:
+    """Label-value escaping per the text exposition spec (0.0.4):
+    backslash, newline and double-quote."""
     return (value.replace("\\", r"\\").replace("\n", r"\n")
             .replace('"', r'\"'))
 
 
+def escape_help(value: str) -> str:
+    """HELP-line escaping per the exposition spec: ONLY backslash and
+    newline — a double-quote in help text is emitted verbatim."""
+    return value.replace("\\", r"\\").replace("\n", r"\n")
+
+
 def _labels_suffix(names: tuple[str, ...], values: tuple[str, ...],
                    extra: str = "") -> str:
-    parts = ['%s="%s"' % (n, _escape(v)) for n, v in zip(names, values)]
+    parts = ['%s="%s"' % (n, escape_label_value(v))
+             for n, v in zip(names, values)]
     if extra:
         parts.append(extra)
     return "{%s}" % ",".join(parts) if parts else ""
@@ -87,6 +102,11 @@ class _Family:
                 raise ValueError("label name %r is not snake_case" % ln)
         self._lock = threading.Lock()
         self._children: dict[tuple[str, ...], object] = {}
+        #: shared sink for label sets beyond MAX_LABEL_SETS — a working
+        #: child of the right type (so hot-path inc/observe never
+        #: raises) that is NEVER rendered (fabricated label values
+        #: would corrupt the exposition)
+        self._overflow = None
         if not self.labelnames:
             self._children[()] = self._make_child()
 
@@ -94,21 +114,33 @@ class _Family:
         raise NotImplementedError
 
     def labels(self, **kv):
-        """Child bound to the given label values (created on demand)."""
+        """Child bound to the given label values (created on demand).
+
+        Beyond :data:`MAX_LABEL_SETS` distinct label sets the guard
+        DROPS the new series: the caller gets a shared unrendered
+        overflow child and ``observability_dropped_series_total``
+        counts the drop — the hot path never raises."""
         if set(kv) != set(self.labelnames):
             raise ValueError(
                 "%s expects labels %r, got %r"
                 % (self.name, self.labelnames, tuple(kv)))
         key = tuple(str(kv[n]) for n in self.labelnames)
+        dropped = False
         with self._lock:
             child = self._children.get(key)
             if child is None:
                 if len(self._children) >= MAX_LABEL_SETS:
-                    raise ValueError(
-                        "label cardinality guard: %s already has %d series"
-                        % (self.name, len(self._children)))
-                child = self._children[key] = self._make_child()
-            return child
+                    if self._overflow is None:
+                        self._overflow = self._make_child()
+                    child = self._overflow
+                    dropped = True
+                else:
+                    child = self._children[key] = self._make_child()
+        if dropped:
+            # counted outside the family lock (the drop counter takes
+            # its own); the counter never counts its own overflow
+            _count_dropped_series(self)
+        return child
 
     def _default_child(self):
         if self.labelnames:
@@ -126,7 +158,8 @@ class _Family:
     def render(self) -> list[str]:
         lines = []
         if self.help:
-            lines.append("# HELP %s %s" % (self.name, _escape(self.help)))
+            lines.append("# HELP %s %s" % (self.name,
+                                           escape_help(self.help)))
         lines.append("# TYPE %s %s" % (self.name, self.kind))
         for values, child in self.children():
             lines.extend(self._render_child(values, child))
@@ -401,3 +434,23 @@ class Registry:
 
 #: the process-wide default registry every instrumented module uses
 REGISTRY = Registry()
+
+#: drops by the per-family cardinality guard — labeled by the family
+#: that overflowed, so a runaway label (a peer address, an unbounded
+#: lifecycle stage) is attributable from /metrics alone
+DROPPED_SERIES = REGISTRY.counter(
+    "observability_dropped_series_total",
+    "Label sets dropped by the cardinality guard (recorded into a "
+    "shared unrendered overflow series instead)", ("metric",))
+
+
+def _count_dropped_series(family: _Family) -> None:
+    """Count one guard drop; self-referential drops (the drop counter
+    itself overflowing on family names) must not recurse."""
+    if family is DROPPED_SERIES:
+        return
+    try:
+        DROPPED_SERIES.labels(metric=family.name).inc()
+    except Exception:  # pragma: no cover — never fail the hot path
+        logger.debug("dropped-series counter update failed",
+                     exc_info=True)

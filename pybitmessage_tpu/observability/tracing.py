@@ -28,10 +28,13 @@ from __future__ import annotations
 import contextvars
 import functools
 import itertools
+import logging
 import threading
 import time
 from collections import deque
 from dataclasses import dataclass, field
+
+logger = logging.getLogger("pybitmessage_tpu.observability")
 
 _current_span: contextvars.ContextVar["Span | None"] = \
     contextvars.ContextVar("pybitmessage_tpu_current_span", default=None)
@@ -140,7 +143,8 @@ class trace:
             try:
                 self._jax_ctx.__exit__(exc_type, exc, tb)
             except Exception:
-                pass
+                logger.debug("jax trace annotation exit failed",
+                             exc_info=True)
             self._jax_ctx = None
         _current_span.reset(self._token)
         self.span.duration = duration

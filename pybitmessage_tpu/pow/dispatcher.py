@@ -51,6 +51,14 @@ FALLBACKS = REGISTRY.counter(
     "pow_fallback_total",
     "Ladder fallthrough events (a tier failed and a slower one took "
     "over)", ("from", "to"))
+
+
+def _note_fallback(frm: str, to: str) -> None:
+    """One ladder fallthrough: counted AND flight-recorded — the tier
+    history right before a stall is post-mortem gold."""
+    FALLBACKS.labels(**{"from": frm, "to": to}).inc()
+    from ..observability.flightrec import record as _flight
+    _flight("pow_fallback", frm=frm, to=to)
 TRIALS = REGISTRY.counter(
     "pow_trials_total", "Double-SHA512 trial hashes executed",
     ("backend",))
@@ -311,9 +319,7 @@ class PowDispatcher:
                             logger.exception(
                                 "batched TPU PoW failed; falling back to "
                                 "per-object solves")
-                            FALLBACKS.labels(
-                                **{"from": "tpu-batch",
-                                   "to": "ladder"}).inc()
+                            _note_fallback("tpu-batch", "ladder")
                 elif self._on_accelerator() and pb.allow():
                     # single chip: the async double-buffered pipeline
                     # plans the launch shape (multi-object slab packing
@@ -412,7 +418,7 @@ class PowDispatcher:
         self._note_stall(exc)
         self.breakers["tpu-pallas"].record_failure()
         ERRORS.labels(site="pow.tier.tpu-pallas").inc()
-        FALLBACKS.labels(**{"from": "tpu-pallas", "to": to}).inc()
+        _note_fallback("tpu-pallas", to)
 
     def _solve(self, initial_hash, target, start_nonce, should_stop,
                progress=None):
@@ -511,7 +517,7 @@ class PowDispatcher:
                 next_tier = ("native"
                              if self._native is not None
                              and self._native.available else "python")
-                FALLBACKS.labels(**{"from": "tpu", "to": next_tier}).inc()
+                _note_fallback("tpu", next_tier)
         if self._native is not None and self._native.available:
             cb = self.breakers["cpp"]
             if cb.allow():
@@ -531,8 +537,7 @@ class PowDispatcher:
                     ERRORS.labels(site="pow.tier.cpp").inc()
                     logger.exception(
                         "C++ PoW failed; falling through to python")
-                    FALLBACKS.labels(
-                        **{"from": "native", "to": "python"}).inc()
+                    _note_fallback("native", "python")
         self.last_backend = "python"
         ATTEMPTS.labels(backend=self.last_backend).inc()
         return python_solve(initial_hash, target, start_nonce=start_nonce,

@@ -49,6 +49,7 @@ import jax
 import jax.numpy as jnp
 
 from ..observability import DEFAULT_SIZE_BUCKETS, REGISTRY
+from ..observability.flightrec import record as _flight
 from ..ops.pow_search import PowInterrupted
 from ..resilience.chaos import inject
 from ..resilience.watchdog import STALLS, SlabStallError
@@ -374,6 +375,12 @@ class _PipelineDriver:
             return fut.result(self.stall_timeout)
         except cf.TimeoutError:
             STALLS.labels(site="pow.slab").inc()
+            # black box: dump the ring while the pre-stall context
+            # (launches, breaker flips, chaos fires) is still in it
+            from ..observability.flightrec import FLIGHT_RECORDER
+            FLIGHT_RECORDER.record("stall", site="pow.slab",
+                                   timeout=self.stall_timeout)
+            FLIGHT_RECORDER.dump("stall")
             logger.error("pow.slab stalled: harvest exceeded %.1fs; "
                          "abandoning the launch and falling back",
                          self.stall_timeout)
@@ -412,6 +419,8 @@ class _PipelineDriver:
                     inflight.append(nxt)
                     self.slabs += 1
                     PIPELINE_DEPTH.set(len(inflight))
+                    _flight("slab_launch", n=self.slabs,
+                            inflight=len(inflight))
                 if not inflight:
                     break
                 DISPATCH_AHEAD.observe(len(inflight))
@@ -422,6 +431,8 @@ class _PipelineDriver:
                 self.wait_seconds += dt
                 DEVICE_WAIT.observe(dt)
                 PIPELINE_DEPTH.set(len(inflight))
+                _flight("slab_harvest", wait_ms=round(dt * 1e3, 2),
+                        inflight=len(inflight))
                 harvest(tag, host)
         finally:
             PIPELINE_DEPTH.set(0)

@@ -34,6 +34,8 @@ import time
 from dataclasses import dataclass, field
 
 from ..observability import DEFAULT_SIZE_BUCKETS, REGISTRY
+from ..observability.flightrec import record as _flight
+from ..observability.lifecycle import LIFECYCLE
 from ..ops.pow_search import PowInterrupted
 from ..resilience import RetryPolicy
 from ..resilience.policy import ERRORS
@@ -185,6 +187,10 @@ class PowService:
             if req.start_nonce:
                 logger.info("resuming journaled PoW job %d from nonce "
                             "offset %d", req.job_id, req.start_nonce)
+        # lifecycle: locally-generated objects enter the timeline via
+        # their pre-nonce initial hash (the inventory hash only exists
+        # after the winning nonce is prepended)
+        LIFECYCLE.record(initial_hash, "pow_queued")
         await self.queue.put(req)
         QUEUE_DEPTH.set(self.queue.qsize())
         return await fut
@@ -244,11 +250,13 @@ class PowService:
                     self._journal_call(
                         lambda j=req.job_id: self.journal.complete(j),
                         site="pow.journal.complete")
+                LIFECYCLE.record(req.initial_hash, "pow_solved")
                 if not req.future.done():
                     req.future.set_result(res)
 
     def _settle_interrupted(self, batch: list[_Request]) -> None:
         REQUEUED.labels(reason="interrupt").inc(len(batch))
+        _flight("pow_requeue", reason="interrupt", n=len(batch))
         for req in batch:
             if req.job_id is not None:
                 self._journal_call(
@@ -283,6 +291,8 @@ class PowService:
         if not survivors:
             return
         REQUEUED.labels(reason="failure").inc(len(survivors))
+        _flight("pow_requeue", reason="failure", n=len(survivors),
+                error=repr(exc)[:120])
         attempt = min(r.attempts for r in survivors) - 1
         pause = self.retry.delay(attempt)
         logger.warning(

@@ -143,6 +143,8 @@ class ChaosRegistry:
             s.fired += 1
             exc, delay = s.exc, s.delay
         FAULTS.labels(site=site).inc()
+        from ..observability.flightrec import record as _flight
+        _flight("chaos", site=site, exc=exc.__name__)
         if delay > 0:
             import time
             time.sleep(delay)

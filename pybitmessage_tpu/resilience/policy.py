@@ -265,10 +265,15 @@ class CircuitBreaker:
         # caller holds the lock
         if to == self._state:
             return
+        frm = self._state
         self._state = to
         if self._registered:
             BREAKER_STATE.labels(breaker=self.label).set(_STATE_VALUE[to])
         BREAKER_TRANSITIONS.labels(breaker=self.label, to=to).inc()
+        # black-box trail: breaker flips are exactly the events a
+        # post-mortem wants in the seconds before a stall/fatal dump
+        from ..observability.flightrec import record as _flight
+        _flight("breaker", name=self.name, frm=frm, to=to)
         logger.info("breaker %s -> %s", self.name, to)
 
     @property

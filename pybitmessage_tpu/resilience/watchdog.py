@@ -24,6 +24,7 @@ import threading
 from typing import Callable
 
 from ..observability import REGISTRY
+from ..observability.flightrec import FLIGHT_RECORDER
 
 logger = logging.getLogger("pybitmessage_tpu.resilience")
 
@@ -75,6 +76,12 @@ class StallGuard:
         t.start()
         if not done.wait(self.timeout):
             STALLS.labels(site=self.site).inc()
+            # black box: the ring holds the breaker flips / chaos
+            # fires / slab traffic of the seconds leading up to this —
+            # dump it NOW, while the context is still in the ring
+            FLIGHT_RECORDER.record("stall", site=self.site,
+                                   timeout=self.timeout)
+            FLIGHT_RECORDER.dump("stall")
             logger.error("%s stalled: launch exceeded %.1fs; abandoning "
                          "it and falling back", self.site, self.timeout)
             raise SlabStallError(

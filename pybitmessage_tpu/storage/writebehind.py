@@ -31,6 +31,7 @@ import threading
 import time
 
 from ..observability import DEFAULT_SIZE_BUCKETS, REGISTRY
+from ..observability.lifecycle import LIFECYCLE
 from .messages import MessageStore
 
 logger = logging.getLogger("pybitmessage_tpu.storage")
@@ -94,6 +95,9 @@ class WriteBehindStore:
                  str(int(time.time())), message, "inbox", encoding,
                  False, sighash))
             self._update_gauge()
+        # msgid IS the inventory hash — the lifecycle "stored" stage
+        # marks acceptance into the (buffered) store, not the fsync
+        LIFECYCLE.record(msgid, "stored")
         return True
 
     def store_pubkey(self, address: str, version: int, payload: bytes,

@@ -19,6 +19,7 @@ from collections import deque
 
 from ..network.messages import decode_inv, encode_inv
 from ..network.tracker import ConnectionTracker, GlobalTracker
+from ..observability.lifecycle import LifecycleTracer
 from .digest import InventoryDigest
 from .reconciler import FRAME_OVERHEAD, Reconciler
 
@@ -141,6 +142,7 @@ class SimNode:
             self.digest.add(h, 1, 1 << 60)
         if source is not None:
             self.mesh.stats.deliveries += 1
+            self.mesh.lifecycle.observe_propagation(h)
         targets = [c for c in self.conns.values() if c is not source]
         if self.reconciler is not None:
             self.reconciler.route_announcement(h, targets)
@@ -221,6 +223,17 @@ class Mesh:
         #: echo-suppression window
         self.buckets = max(1, buckets)
         self._tick_no = 0
+        #: cross-node propagation tracing (ISSUE 6): one tracer per
+        #: mesh on the simulated tick clock — inject() stamps the
+        #: origin event, every delivery at another node observes the
+        #: tick delta.  bench.py sync_storm reports its p50/p90/p99,
+        #: the metric ROADMAP item 5 (scenario lab) is built on.  The
+        #: tracer is mesh-local so flood/sync comparison runs don't
+        #: contaminate each other; the process-wide histogram
+        #: ``object_propagation_seconds`` still accumulates.
+        self.lifecycle = LifecycleTracer(
+            maxlen=1 << 16, clock=lambda: float(self._tick_no),
+            update_gauge=False)
         self.nodes = [SimNode(i, self) for i in range(n)]
         if edges is None:
             edges = [(a, b) for a in range(n) for b in range(a + 1, n)]
@@ -246,6 +259,7 @@ class Mesh:
         """A new object appears at ``origin`` (locally generated)."""
         if payload is None:
             payload = h + b"\xAA" * max(0, SIM_OBJECT_SIZE - 32)
+        self.lifecycle.record(h, "received")
         self.nodes[origin].add_object(h, payload, source=None)
 
     def seed(self, node: int, hashes) -> None:

@@ -42,6 +42,8 @@ import random
 import time
 
 from ..observability import REGISTRY
+from ..observability.flightrec import record as _flight
+from ..observability.lifecycle import LIFECYCLE
 from ..resilience import CircuitBreaker, Deadline, RetryPolicy, inject
 from ..resilience.policy import ERRORS
 from .sketch import Sketch, capacity_for, normalize_cells, short_id_map
@@ -321,6 +323,8 @@ class Reconciler:
                     s.catchup_salt = None
                     s.catchup_deadline = None
                     ROUNDS.labels(outcome="catchup_timeout").inc()
+                    _flight("sync_round", peer=conn.host,
+                            outcome="catchup_timeout")
                     FALLBACKS.inc()
                     await self._big_inv(conn)
                 if s.state == AWAIT_SKETCH and s.deadline is not None \
@@ -452,6 +456,7 @@ class Reconciler:
         s.next_due = 0.0
         DIFF_SIZE.observe(diff)
         ROUNDS.labels(outcome="ok").inc()
+        _flight("sync_round", peer=conn.host, outcome="ok", diff=diff)
         self._delivered(len(want))
 
     # -- responder side -------------------------------------------------------
@@ -511,6 +516,8 @@ class Reconciler:
                 s.catchup_deadline = None
                 FALLBACKS.inc()
                 ROUNDS.labels(outcome="catchup_refused").inc()
+                _flight("sync_round", peer=conn.host,
+                        outcome="catchup_refused")
                 await self._big_inv(conn)
                 return
             # the initiator could not decode OUR round: it floods
@@ -638,6 +645,8 @@ class Reconciler:
                 RECONDIFF_DECODE_FAILED, salt, 0, [], []))
             FALLBACKS.inc()
             ROUNDS.labels(outcome="catchup_refused").inc()
+            _flight("sync_round", peer=conn.host,
+                    outcome="catchup_refused")
             await self._big_inv(conn)
             return
         snapshot = short_id_map(population, salt)
@@ -668,6 +677,8 @@ class Reconciler:
             logger.debug("catch-up decode with %s failed: %r",
                          conn.host, exc)
             ROUNDS.labels(outcome="catchup_failed").inc()
+            _flight("sync_round", peer=conn.host,
+                    outcome="catchup_failed")
             FALLBACKS.inc()
             try:
                 await self._send(conn, "recondiff", encode_recondiff(
@@ -691,6 +702,8 @@ class Reconciler:
             RECONDIFF_OK, salt, diff, unpushable, want))
         await self._push_objects(s, pushable)
         ROUNDS.labels(outcome="catchup_ok").inc()
+        _flight("sync_round", peer=conn.host, outcome="catchup_ok",
+                diff=diff)
         DIFF_SIZE.observe(diff)
         self._delivered(len(want))
 
@@ -711,6 +724,8 @@ class Reconciler:
         its snapshot (flooded classically or ridden into the next
         round), open the breaker ladder, back off."""
         ROUNDS.labels(outcome=outcome).inc()
+        _flight("sync_round", peer=s.conn.host, outcome=outcome,
+                failures=s.failures + 1)
         s.failures += 1
         base = s.ewma_diff if s.ewma_diff is not None else 8.0
         grown = min(max(base * 2 + 8, len(s.snapshot) * 0.75),
@@ -812,6 +827,7 @@ class Reconciler:
             if h in s.known:
                 continue
             s.mark_known(h)
+            LIFECYCLE.record(h, "sync_pushed")
             await s.conn.send_packet("object", payload)
 
     async def _send(self, conn, command: str, payload: bytes) -> None:

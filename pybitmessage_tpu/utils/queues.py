@@ -66,9 +66,13 @@ class WatermarkQueue(asyncio.Queue):
             self.paused = True
             self._resume.clear()
             INGEST_PAUSES.inc()
+            from ..observability.flightrec import record as _flight
+            _flight("ingest_pause", depth=size, high=self.high)
         elif self.paused and size <= self.low:
             self.paused = False
             self._resume.set()
+            from ..observability.flightrec import record as _flight
+            _flight("ingest_resume", depth=size, low=self.low)
 
     def put_nowait(self, item) -> None:
         super().put_nowait(item)

@@ -42,6 +42,7 @@ from ..models.payloads import (
 )
 from ..models.pow_math import pow_target, pow_value
 from ..observability import REGISTRY, trace
+from ..observability.lifecycle import LIFECYCLE
 from ..storage.messages import ACKRECEIVED, MessageStore
 from ..utils.addresses import encode_address
 from ..utils.hashes import address_ripe, inventory_hash, sha512
@@ -250,6 +251,12 @@ class ObjectProcessor:
         except Exception:
             OBJECTS_PROCESSED.labels(type="unparseable").inc()
             return
+        # one inventory hash per object, computed here and threaded
+        # through the handlers: it keys the lifecycle timeline AND
+        # replaces the repeated inventory_hash(payload) calls the
+        # delivery paths used to make
+        h = inventory_hash(payload)
+        LIFECYCLE.record(h, "parsed")
         kind = "other"
         try:
             with trace("processor.object",
@@ -262,10 +269,10 @@ class ObjectProcessor:
                     await self._process_pubkey(header, payload)
                 elif header.object_type == OBJECT_MSG:
                     kind = "msg"
-                    await self._process_msg(header, payload)
+                    await self._process_msg(header, payload, h)
                 elif header.object_type == OBJECT_BROADCAST:
                     kind = "broadcast"
-                    await self._process_broadcast(header, payload)
+                    await self._process_broadcast(header, payload, h)
                 elif header.object_type == OBJECT_ONIONPEER:
                     kind = "onionpeer"
                     self._process_onionpeer(header, payload)
@@ -430,7 +437,7 @@ class ObjectProcessor:
     # -- msg -----------------------------------------------------------------
 
     async def _process_msg(self, header: ObjectHeader,
-                           payload: bytes) -> None:
+                           payload: bytes, h: bytes) -> None:
         self.messages_processed += 1
         if self._check_ackdata(payload):
             return
@@ -452,6 +459,7 @@ class ObjectProcessor:
         if not matches:
             return
         decrypted, match = matches[0]
+        LIFECYCLE.record(h, "decrypted")
 
         try:
             plain = MsgPlaintext.decode(decrypted)
@@ -471,6 +479,7 @@ class ObjectProcessor:
         if not sig_ok:
             logger.debug("msg signature invalid")
             return
+        LIFECYCLE.record(h, "verified")
         # demanded-difficulty recheck (objectProcessor.py:615-629);
         # pow_value double-hashes the whole payload — off the loop too
         if not match.chan:
@@ -519,13 +528,14 @@ class ObjectProcessor:
             # buffer-aware); the direct store still runs off the loop
             delivered = await self.crypto.run(
                 lambda: self.store.deliver_inbox(
-                    msgid=inventory_hash(payload),
+                    msgid=h,
                     toaddress=match.address, fromaddress=display_from,
                     subject=subject, message=body.body,
                     encoding=plain.encoding, sighash=sighash))
         if not delivered:
             logger.debug("duplicate message dropped (sighash)")
             return
+        LIFECYCLE.record(h, "delivered")
         # denial surfaced only for the first (non-duplicate) delivery —
         # a gateway retry must not re-notify every frontend
         if feedback == REGISTRATION_DENIED:
@@ -536,7 +546,7 @@ class ObjectProcessor:
         logger.info("message delivered: %s -> %s", display_from,
                     match.address)
         self.ui_signal("displayNewInboxMessage",
-                       (inventory_hash(payload), match.address,
+                       (h, match.address,
                         display_from, subject, body.body))
         # mailing-list identities re-send what they receive as a
         # broadcast to their subscribers (objectProcessor.py:688-721)
@@ -605,7 +615,7 @@ class ObjectProcessor:
     # -- broadcast -----------------------------------------------------------
 
     async def _process_broadcast(self, header: ObjectHeader,
-                                 payload: bytes) -> None:
+                                 payload: bytes, h: bytes) -> None:
         self.broadcasts_processed += 1
         i = header.header_length
         if header.version == 5:
@@ -624,6 +634,8 @@ class ObjectProcessor:
         with _Stage("decrypt"):
             matches = await self.crypto.try_decrypt_many(
                 encrypted, [(s.broadcast_key, s) for s in subs])
+        if matches:
+            LIFECYCLE.record(h, "decrypted")
         for decrypted, sub in matches:
             try:
                 plain = BroadcastPlaintext.decode(decrypted)
@@ -644,18 +656,21 @@ class ObjectProcessor:
             if not sig_ok:
                 logger.debug("broadcast signature invalid")
                 continue
+            LIFECYCLE.record(h, "verified")
             body = msgcoding.decode_message(plain.message, plain.encoding)
             with _Stage("store"):
-                await self.crypto.run(
+                delivered = await self.crypto.run(
                     lambda: self.store.deliver_inbox(
-                        msgid=inventory_hash(payload),
+                        msgid=h,
                         toaddress="[Broadcast]", fromaddress=sub.address,
                         subject=body.subject, message=body.body,
                         encoding=plain.encoding,
                         sighash=sha512(plain.signature)))
+            if delivered:
+                LIFECYCLE.record(h, "delivered")
             logger.info("broadcast delivered from %s", sub.address)
             self.ui_signal("displayNewInboxMessage",
-                           (inventory_hash(payload), "[Broadcast]",
+                           (h, "[Broadcast]",
                             sub.address, body.subject, body.body))
             return
 

@@ -12,6 +12,8 @@ import base64
 import hashlib
 from types import SimpleNamespace
 
+import pytest
+
 from pybitmessage_tpu.api import APIServer
 from pybitmessage_tpu.observability import REGISTRY
 from pybitmessage_tpu.pow import PowDispatcher
@@ -115,5 +117,58 @@ def test_metrics_api_command_matches_endpoint():
         text = await handler.dispatch("metrics", [])
         assert "# TYPE pow_solve_seconds histogram" in text
         assert text.endswith("\n")
+
+    asyncio.run(body())
+
+
+def test_dump_flight_recorder_api_command():
+    """`dumpFlightRecorder` returns the ring (newest last) and counts
+    an api-triggered dump; the optional kind argument filters."""
+    import json
+
+    from pybitmessage_tpu.api.commands import CommandHandler
+    from pybitmessage_tpu.observability import FLIGHT_RECORDER, REGISTRY
+
+    async def body():
+        handler = CommandHandler(SimpleNamespace())
+        FLIGHT_RECORDER.record("breaker", name="api.test", to="open")
+        FLIGHT_RECORDER.record("chaos", site="api.test_site")
+        before = REGISTRY.sample("flightrec_dumps_total",
+                                 {"trigger": "api"})
+        out = json.loads(await handler.dispatch("dumpFlightRecorder", []))
+        kinds = [e["kind"] for e in out["events"]]
+        assert "breaker" in kinds and "chaos" in kinds
+        assert REGISTRY.sample("flightrec_dumps_total",
+                               {"trigger": "api"}) == before + 1
+        out = json.loads(await handler.dispatch(
+            "dumpFlightRecorder", ["chaos"]))
+        assert out["events"]
+        assert all(e["kind"] == "chaos" for e in out["events"])
+
+    asyncio.run(body())
+
+
+def test_object_timeline_api_command():
+    """`objectTimeline` returns the lifecycle stages of one hash and
+    refuses malformed hex lengths."""
+    import json
+
+    from pybitmessage_tpu.api.commands import APIError, CommandHandler
+    from pybitmessage_tpu.observability import LIFECYCLE
+
+    async def body():
+        handler = CommandHandler(SimpleNamespace())
+        h = b"\xA5" * 32
+        LIFECYCLE.record(h, "received")
+        LIFECYCLE.record(h, "stored")
+        try:
+            out = json.loads(await handler.dispatch(
+                "objectTimeline", [h.hex()]))
+            assert [e["stage"] for e in out["timeline"]] == [
+                "received", "stored"]
+        finally:
+            LIFECYCLE.discard(h)
+        with pytest.raises(APIError):
+            await handler.dispatch("objectTimeline", ["ab"])
 
     asyncio.run(body())

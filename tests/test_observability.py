@@ -67,13 +67,42 @@ def test_registry_register_is_idempotent():
         reg.gauge("same_total")  # type change must be refused
 
 
-def test_label_cardinality_guard():
+def test_label_cardinality_guard_drops_never_raises():
+    """ISSUE 6 satellite: beyond MAX_LABEL_SETS the guard must DROP
+    (shared unrendered overflow child + a drop counter), never raise —
+    high-cardinality lifecycle labels must not crash the hot path."""
     reg = Registry()
     c = reg.counter("wide_total", "w", ("peer",))
     for i in range(MAX_LABEL_SETS):
         c.labels(peer=str(i)).inc()
-    with pytest.raises(ValueError, match="cardinality"):
-        c.labels(peer="one-too-many")
+    drops0 = REGISTRY.sample("observability_dropped_series_total",
+                             {"metric": "wide_total"})
+    # overflow series: inc works (never raises on the hot path)...
+    c.labels(peer="one-too-many").inc()
+    c.labels(peer="two-too-many").inc(5)
+    # ...each drop is counted, attributable to the family...
+    assert REGISTRY.sample("observability_dropped_series_total",
+                           {"metric": "wide_total"}) == drops0 + 2
+    # ...and the exposition never renders fabricated overflow series
+    rendered = [ln for ln in reg.render().splitlines()
+                if ln.startswith("wide_total{")]
+    assert len(rendered) == MAX_LABEL_SETS
+    assert not any("too-many" in ln for ln in rendered)
+    # existing series keep working normally
+    c.labels(peer="0").inc()
+    assert c.labels(peer="0").value == 2
+
+
+def test_cardinality_guard_histogram_overflow_observe():
+    """The overflow child is type-correct: a guarded histogram's
+    observe() works past the cap (the drop is the only signal)."""
+    reg = Registry()
+    h = reg.histogram("wide_seconds", "w", ("k",), buckets=(1.0,))
+    for i in range(MAX_LABEL_SETS):
+        h.labels(k=str(i)).observe(0.5)
+    h.labels(k="overflow").observe(0.5)   # must not raise
+    assert REGISTRY.sample("observability_dropped_series_total",
+                           {"metric": "wide_seconds"}) >= 1
 
 
 def test_histogram_bucket_edges():
@@ -168,6 +197,25 @@ def test_label_value_escaping():
     assert line == 'esc_total{what="say \\"hi\\"\\nback\\\\slash"} 1'
 
 
+def test_exposition_escaping_golden():
+    """ISSUE 6 satellite: full golden text with every escapable class
+    in label values (backslash, newline, double-quote) AND in HELP —
+    where the spec escapes ONLY backslash and newline (a quote stays
+    verbatim)."""
+    from pybitmessage_tpu.observability import (escape_help,
+                                                escape_label_value)
+    assert escape_label_value('a\\b\nc"d') == 'a\\\\b\\nc\\"d'
+    assert escape_help('a\\b\nc"d') == 'a\\\\b\\nc"d'
+    reg = Registry()
+    c = reg.counter("esc2_total", 'help with "quotes"\nand\\slash',
+                    ("v",))
+    c.labels(v='x\\y\n"z"').inc()
+    assert reg.render() == (
+        '# HELP esc2_total help with "quotes"\\nand\\\\slash\n'
+        "# TYPE esc2_total counter\n"
+        'esc2_total{v="x\\\\y\\n\\"z\\""} 1\n')
+
+
 def test_sample_and_snapshot():
     reg = Registry()
     c = reg.counter("s_total", "s", ("k",))
@@ -239,6 +287,42 @@ def test_trace_decorator_and_exception_marking():
     assert t.recent()[-1].attrs["error"] == "RuntimeError"
 
 
+def test_trace_parent_restored_when_body_raises():
+    """ISSUE 6 satellite: the parent contextvar must be restored on
+    the exception path — a raising span body must not leave later
+    spans parented under a dead span."""
+    from pybitmessage_tpu.observability import current_span
+    t = Tracer()
+    assert current_span() is None
+    with trace("outer", tracer=t) as outer:
+        with pytest.raises(RuntimeError):
+            with trace("inner", tracer=t):
+                assert current_span().name == "inner"
+                raise RuntimeError("boom")
+        # inner's exit must restore outer as the current span
+        assert current_span() is outer
+        with trace("sibling", tracer=t) as sib:
+            assert sib.parent_id == outer.span_id
+    assert current_span() is None
+    # the raising span was still recorded, marked, and timed
+    inner = [s for s in t.recent() if s.name == "inner"][0]
+    assert inner.attrs["error"] == "RuntimeError"
+    assert inner.duration is not None
+
+
+def test_trace_decorator_restores_parent_on_raise():
+    t = Tracer()
+    from pybitmessage_tpu.observability import current_span
+
+    @trace("fn.boom", tracer=t)
+    def boom():
+        raise ValueError("x")
+
+    with pytest.raises(ValueError):
+        boom()
+    assert current_span() is None
+
+
 def test_trace_feeds_histogram():
     reg = Registry()
     h = reg.histogram("span_seconds", "s")
@@ -262,27 +346,395 @@ def test_jax_annotation_bridge_toggle():
 
 
 # ---------------------------------------------------------------------------
+# lifecycle tracer (ISSUE 6 tentpole #1)
+# ---------------------------------------------------------------------------
+
+
+def _fresh_tracer(maxlen=8, **kw):
+    from pybitmessage_tpu.observability import LifecycleTracer
+    reg = Registry()
+    hist = reg.histogram("t_stage_seconds", "s", ("from", "to"))
+    prop = reg.histogram("t_prop_seconds", "p")
+    return LifecycleTracer(maxlen=maxlen, stage_histogram=hist,
+                           propagation_histogram=prop,
+                           update_gauge=False, **kw), hist, prop
+
+
+def test_lifecycle_timeline_and_stage_latency():
+    clock = {"t": 0.0}
+    tracer, hist, _ = _fresh_tracer(clock=lambda: clock["t"])
+    h = b"\x01" * 32
+    for stage, t in (("received", 0.0), ("parsed", 0.5),
+                     ("decrypted", 1.5), ("verified", 1.75),
+                     ("stored", 2.0), ("delivered", 2.5)):
+        clock["t"] = t
+        tracer.record(h, stage)
+    timeline = tracer.timeline(h)
+    assert [e["stage"] for e in timeline] == [
+        "received", "parsed", "decrypted", "verified", "stored",
+        "delivered"]
+    # stage-to-stage latency landed per (from, to) pair
+    assert hist.labels(**{"from": "received", "to": "parsed"})._count == 1
+    assert hist.labels(**{"from": "parsed",
+                          "to": "decrypted"})._count == 1
+    assert abs(hist.labels(**{"from": "parsed",
+                              "to": "decrypted"})._sum - 1.0) < 1e-9
+
+
+def test_lifecycle_lru_retention_bound():
+    tracer, _, _ = _fresh_tracer(maxlen=4)
+    for i in range(10):
+        tracer.record(bytes([i]) * 32, "received")
+    assert tracer.tracked() == 4
+    # oldest evicted, newest kept
+    assert tracer.timeline(bytes([0]) * 32) == []
+    assert tracer.timeline(bytes([9]) * 32)
+    # per-timeline event cap
+    h = b"\xFF" * 32
+    for _ in range(200):
+        tracer.record(h, "announced")
+    assert len(tracer.timeline(h)) <= tracer.MAX_EVENTS
+
+
+def test_lifecycle_capped_timeline_stops_observing_latency():
+    """Past MAX_EVENTS the stale last event must not keep feeding the
+    stage histogram with ever-growing fabricated deltas."""
+    clock = {"t": 0.0}
+    tracer, hist, _ = _fresh_tracer(maxlen=4,
+                                    clock=lambda: clock["t"])
+    h = b"\xFE" * 32
+    for i in range(tracer.MAX_EVENTS + 50):
+        clock["t"] = float(i)
+        tracer.record(h, "announced")
+    child = hist.labels(**{"from": "announced", "to": "announced"})
+    # MAX_EVENTS appended events -> MAX_EVENTS - 1 transitions; the 50
+    # capped calls observed nothing
+    assert child._count == tracer.MAX_EVENTS - 1
+    assert child._sum == float(tracer.MAX_EVENTS - 1)
+
+
+def test_lifecycle_snapshot_counts_follow_eviction():
+    """snapshot() per-stage counts are maintained incrementally and
+    shrink when timelines are evicted or discarded."""
+    tracer, _, _ = _fresh_tracer(maxlen=2)
+    a, b, c = (bytes([i]) * 32 for i in (1, 2, 3))
+    tracer.record(a, "received")
+    tracer.record(b, "received")
+    tracer.record(b, "stored")
+    assert tracer.snapshot()["stageEvents"] == {
+        "received": 2, "stored": 1}
+    tracer.record(c, "received")        # evicts a
+    assert tracer.snapshot()["stageEvents"] == {
+        "received": 2, "stored": 1}
+    tracer.discard(b)
+    assert tracer.snapshot()["stageEvents"] == {"received": 1}
+
+
+def test_lifecycle_propagation_percentiles():
+    clock = {"t": 0.0}
+    tracer, _, prop = _fresh_tracer(maxlen=64,
+                                    clock=lambda: clock["t"])
+    for i in range(10):
+        h = bytes([i]) * 32
+        clock["t"] = float(i)
+        tracer.record(h, "received")
+        clock["t"] = float(i) + (1.0 if i < 9 else 5.0)
+        delta = tracer.observe_propagation(h)
+        assert delta is not None
+    pcts = tracer.propagation_percentiles()
+    assert pcts["count"] == 10
+    assert pcts["p50"] == 1.0
+    assert pcts["p99"] == 5.0
+    assert prop._default_child()._count == 10
+    # unknown hash: no origin event, no observation
+    assert tracer.observe_propagation(b"\xEE" * 32) is None
+
+
+def test_lifecycle_record_never_raises():
+    """The hot-path contract: a broken histogram must not surface."""
+    tracer, _, _ = _fresh_tracer()
+
+    class Boom:
+        def labels(self, **kv):
+            raise RuntimeError("broken")
+
+    tracer._stage_hist = Boom()
+    tracer.record(b"\x01" * 32, "received")
+    tracer.record(b"\x01" * 32, "parsed")   # latency path -> Boom
+    assert [e["stage"] for e in tracer.timeline(b"\x01" * 32)] == [
+        "received", "parsed"]
+
+
+def test_lifecycle_disabled_is_noop():
+    tracer, _, _ = _fresh_tracer()
+    tracer.enabled = False
+    tracer.record(b"\x02" * 32, "received")
+    assert tracer.tracked() == 0
+
+
+def test_lifecycle_global_hooks_stage_chain():
+    """The process-wide tracer accumulates the documented chain from
+    the real hook sites' stage names."""
+    from pybitmessage_tpu.observability import LIFECYCLE
+    from pybitmessage_tpu.observability.lifecycle import STAGES
+    for s in ("received", "parsed", "decrypted", "verified", "stored",
+              "announced", "sync_pushed", "delivered"):
+        assert s in STAGES
+    h = b"\xAB" * 32
+    LIFECYCLE.record(h, "received")
+    LIFECYCLE.record(h, "parsed")
+    assert [e["stage"] for e in LIFECYCLE.timeline(h)] == [
+        "received", "parsed"]
+    LIFECYCLE.discard(h)
+    assert LIFECYCLE.timeline(h) == []
+
+
+# ---------------------------------------------------------------------------
+# flight recorder (ISSUE 6 tentpole #2)
+# ---------------------------------------------------------------------------
+
+
+def test_flightrec_ring_bound_and_filter():
+    from pybitmessage_tpu.observability import FlightRecorder
+    rec = FlightRecorder(maxlen=16)
+    for i in range(50):
+        rec.record("breaker" if i % 2 else "chaos", i=i)
+    events = rec.events()
+    assert len(events) == 16
+    assert events[-1]["i"] == 49          # newest kept
+    assert all(e["i"] >= 34 for e in events)
+    assert all(e["kind"] == "chaos" for e in rec.events(kind="chaos"))
+    assert len(rec.events(3)) == 3
+    rec.resize(8)
+    assert len(rec.events()) == 8
+
+
+def test_flightrec_dump_counts_and_logs():
+    import logging
+
+    from pybitmessage_tpu.observability import FlightRecorder
+    rec = FlightRecorder(maxlen=16)
+    rec.record("stall", site="pow.slab")
+    before = REGISTRY.sample("flightrec_dumps_total",
+                             {"trigger": "stall"})
+    logger = logging.getLogger("test.flightrec")
+    records = []
+    handler = logging.Handler()
+    handler.emit = records.append
+    logger.addHandler(handler)
+    try:
+        events = rec.dump("stall", log=logger)
+    finally:
+        logger.removeHandler(handler)
+    assert events and events[-1]["kind"] == "stall"
+    assert REGISTRY.sample("flightrec_dumps_total",
+                           {"trigger": "stall"}) == before + 1
+    assert records and "flightrec_dump" in records[0].getMessage()
+
+
+def test_flightrec_stall_guard_auto_dumps():
+    """StallGuard's stall detection must leave the triggering event in
+    the ring and emit an automatic dump (the acceptance path)."""
+    from pybitmessage_tpu.observability import FLIGHT_RECORDER
+    from pybitmessage_tpu.resilience.watchdog import (SlabStallError,
+                                                      StallGuard)
+    before = REGISTRY.sample("flightrec_dumps_total",
+                             {"trigger": "stall"})
+    guard = StallGuard(timeout=0.05, site="pow.slab")
+    with pytest.raises(SlabStallError):
+        guard.run(lambda: time.sleep(2.0))
+    assert REGISTRY.sample("flightrec_dumps_total",
+                           {"trigger": "stall"}) == before + 1
+    stalls = FLIGHT_RECORDER.events(kind="stall")
+    assert stalls and stalls[-1]["site"] == "pow.slab"
+
+
+def test_flightrec_breaker_and_chaos_events():
+    """Breaker transitions and chaos fires land in the ring."""
+    from pybitmessage_tpu.observability import FLIGHT_RECORDER
+    from pybitmessage_tpu.resilience import CHAOS, CircuitBreaker
+    br = CircuitBreaker("test.flight", threshold=1, cooldown=60.0,
+                        register=False)
+    br.record_failure()
+    flips = FLIGHT_RECORDER.events(kind="breaker")
+    assert flips and flips[-1]["name"] == "test.flight"
+    assert flips[-1]["to"] == "open"
+    CHAOS.arm("test.flight_site", probability=1.0, count=1)
+    try:
+        with pytest.raises(Exception):
+            CHAOS.inject("test.flight_site")
+    finally:
+        CHAOS.disarm("test.flight_site")
+    fires = FLIGHT_RECORDER.events(kind="chaos")
+    assert fires and fires[-1]["site"] == "test.flight_site"
+
+
+# ---------------------------------------------------------------------------
+# health probes (ISSUE 6 tentpole #3)
+# ---------------------------------------------------------------------------
+
+
+def test_loop_lag_probe_observes_blockage():
+    from pybitmessage_tpu.observability import LoopLagProbe
+
+    reg = Registry()
+    hist = reg.histogram("lag_seconds", "l")
+
+    async def scenario():
+        probe = LoopLagProbe(0.005, histogram=hist)
+        probe.start()
+        await asyncio.sleep(0.03)
+        time.sleep(0.08)          # block the loop
+        await asyncio.sleep(0.03)
+        await probe.stop()
+        return probe
+
+    probe = asyncio.run(scenario())
+    assert hist.count >= 2
+    assert probe.max_lag >= 0.05
+    # the health verdict reads the RECENT window, not the cumulative
+    # histogram — the blockage must show up in it
+    assert probe.recent_p99() >= 0.05
+
+
+def test_health_block_shapes():
+    from pybitmessage_tpu.observability import HealthMonitor
+    mon = HealthMonitor(None)
+    block = mon.health_block()
+    assert block["loop"]["status"] in ("ok", "degraded")
+    assert "lagP99Ms" in block["loop"]
+
+    class _Queue:
+        paused = False
+
+        def qsize(self):
+            return 3
+
+    class _Proc:
+        concurrency = 8
+        active = 2
+        crypto = None
+        _wb = None
+
+    class _Node:
+        processor = _Proc()
+        reconciler = None
+
+        class ctx:
+            object_queue = _Queue()
+
+    mon = HealthMonitor(_Node())
+    mon.sample()
+    block = mon.health_block()
+    assert set(block) >= {"loop", "pow", "ingest", "storage"}
+    assert block["ingest"]["queueDepth"] == 3
+    assert block["ingest"]["status"] == "ok"
+    _Queue.paused = True
+    assert mon.health_block()["ingest"]["status"] == "degraded"
+    _Queue.paused = False
+
+
+# ---------------------------------------------------------------------------
+# perf guard (ISSUE 6 tentpole #4: tools/bench_compare.py)
+# ---------------------------------------------------------------------------
+
+
+def _bench_compare():
+    import importlib.util
+    import pathlib
+    path = (pathlib.Path(__file__).parent.parent / "tools"
+            / "bench_compare.py")
+    spec = importlib.util.spec_from_file_location("bench_compare", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_perfguard_compare_tolerance_bands():
+    """Per-metric bands: 'higher' fails below baseline*(1-tol),
+    'lower' fails above baseline*(1+tol), 'equal' fails on any
+    difference."""
+    bc = _bench_compare()
+    guards = [("rate", "higher", 0.50), ("lag", "lower", 1.00),
+              ("lossless", "equal", 0.0)]
+    base = {"rate": 100.0, "lag": 2.0, "lossless": True}
+    ok = {"rate": 51.0, "lag": 3.9, "lossless": True}
+    failures, notes = bc.compare(base, ok, guards)
+    assert not failures and len(notes) == 3
+    bad = {"rate": 49.0, "lag": 4.1, "lossless": False}
+    failures, _ = bc.compare(base, bad, guards)
+    assert len(failures) == 3
+
+
+def test_perfguard_missing_metric_is_a_regression():
+    """A metric the baseline carries but the run lost FAILS (silent
+    coverage loss is itself a regression) — unless its section is
+    explicitly marked skipped (optional dep absent on the host)."""
+    bc = _bench_compare()
+    guards = [("configs.ingest.objects_per_s", "higher", 0.5)]
+    base = {"configs": {"ingest": {"objects_per_s": 50.0}}}
+    failures, _ = bc.compare(base, {"configs": {}}, guards)
+    assert failures and failures[0].startswith("LOST")
+    skipped = {"configs": {"ingest": {"skipped": "no cryptography"}}}
+    failures, notes = bc.compare(base, skipped, guards)
+    assert not failures
+    assert any("skipped" in n for n in notes)
+    # absent from the BASELINE: skipped quietly (new metric, old file)
+    failures, notes = bc.compare({}, {"configs": {}}, guards)
+    assert not failures and any(n.startswith("SKIP") for n in notes)
+
+
+def test_perfguard_committed_baseline_is_consistent():
+    """The committed smoke baseline must parse and carry at least the
+    machine-independent invariant guards (the 'equal' kind) so
+    perfguard can never silently guard nothing."""
+    import json
+    import pathlib
+    bc = _bench_compare()
+    path = pathlib.Path(bc.DEFAULT_BASELINE)
+    assert path.exists(), "commit bench_baseline_smoke.json " \
+        "(generate: python tools/bench_compare.py --run --update)"
+    baseline = json.loads(path.read_text())
+    equal_guards = [p for p, kind, _ in bc.GUARDS if kind == "equal"]
+    carried = [p for p in equal_guards
+               if bc.dig(baseline, p) is not None]
+    assert carried, "baseline carries no invariant guards"
+
+
+# ---------------------------------------------------------------------------
 # overhead budget (acceptance: <2% on the python-tier solve loop)
 # ---------------------------------------------------------------------------
 
 
 def test_tracing_overhead_under_two_percent():
-    """One span wraps one dispatcher solve; its cost must be <2% of a
-    realistic python-tier solve (~20k trials).  Measured generously:
-    span cost is amortized over 2000 enter/exits."""
+    """One span + the ISSUE 6 per-object telemetry (two lifecycle
+    stage records and one flight-recorder event) wrap one dispatcher
+    solve; their combined cost must be <2% of a realistic python-tier
+    solve (~20k trials).  Measured generously: amortized over 2000
+    iterations."""
     import hashlib
 
+    from pybitmessage_tpu.observability import (FlightRecorder,
+                                                LifecycleTracer)
     from pybitmessage_tpu.ops.pow_search import PowInterrupted
     from pybitmessage_tpu.pow import python_solve
 
     reg = Registry()
     h = reg.histogram("ovh_seconds", "o")
+    stage_h = reg.histogram("ovh_stage_seconds", "o", ("from", "to"))
+    lc = LifecycleTracer(maxlen=4096, stage_histogram=stage_h,
+                         update_gauge=False)
+    fr = FlightRecorder(maxlen=256)
     t = Tracer()
     n = 2000
+    keys = [i.to_bytes(32, "big") for i in range(n)]
     t0 = time.perf_counter()
-    for _ in range(n):
+    for i in range(n):
         with trace("pow.solve", histogram=h):
             pass
+        lc.record(keys[i], "received")
+        lc.record(keys[i], "parsed")
+        fr.record("slab_launch", n=i)
     span_cost = (time.perf_counter() - t0) / n
 
     calls = []
@@ -338,7 +790,7 @@ def test_no_silent_exception_swallows():
             isinstance(stmt.value, ast.Constant)
 
     offenders = []
-    for pkg in ("pow", "network", "sync"):
+    for pkg in ("pow", "network", "sync", "observability"):
         for path in sorted((root / pkg).glob("*.py")):
             tree = ast.parse(path.read_text(), filename=str(path))
             for node in ast.walk(tree):
@@ -367,6 +819,9 @@ def test_metric_naming_conventions():
             "pybitmessage_tpu.storage.inventory",
             "pybitmessage_tpu.storage.writebehind",
             "pybitmessage_tpu.sync.reconciler",
+            "pybitmessage_tpu.observability.lifecycle",
+            "pybitmessage_tpu.observability.flightrec",
+            "pybitmessage_tpu.observability.health",
             "pybitmessage_tpu.utils.queues",
             "pybitmessage_tpu.workers.cryptopool",
             "pybitmessage_tpu.workers.sender",

@@ -328,3 +328,29 @@ def test_breaker_and_stall_state_visible_in_metrics_and_clientstatus():
     for key in ("stallEvents", "powRequeues", "journal", "chaos",
                 "handshakeTimeouts"):
         assert key in stats
+
+
+def test_seeded_chaos_run_lands_in_flight_recorder_dump():
+    """ISSUE 6 acceptance: a seeded chaos run that trips a breaker
+    leaves the triggering events (chaos fire + breaker transition) in
+    the flight-recorder ring, and a dump contains them."""
+    from pybitmessage_tpu.observability import FLIGHT_RECORDER
+
+    d = PowDispatcher(use_native=False,
+                      tpu_kwargs={"lanes": 256, "chunks_per_call": 8})
+    CHAOS.arm("pow.device_launch", probability=1.0, count=3)
+    d.solve_batch([(_ih("flightrec"), EASY)])
+
+    before = REGISTRY.sample("flightrec_dumps_total", {"trigger": "api"})
+    events = FLIGHT_RECORDER.dump("api")
+    assert REGISTRY.sample("flightrec_dumps_total",
+                           {"trigger": "api"}) == before + 1
+    chaos_events = [e for e in events if e.get("kind") == "chaos"
+                    and e.get("site") == "pow.device_launch"]
+    assert chaos_events, "chaos injection missing from the dump"
+    breaker_events = [e for e in events if e.get("kind") == "breaker"]
+    assert breaker_events, "breaker transition missing from the dump"
+    # the dump orders by sequence: the post-mortem can reconstruct
+    # what fired in the run-up
+    seqs = [e["seq"] for e in events]
+    assert seqs == sorted(seqs)

@@ -1,0 +1,219 @@
+#!/usr/bin/env python3
+"""Perf guard: diff a bench run against the committed baseline.
+
+The BENCH_r01..r05 trajectory (19 -> 192 MH/s/chip) was only ever
+guarded by humans reading JSON.  This tool closes the loop (ISSUE 6):
+
+    python tools/bench_compare.py --run                  # make perfguard
+    python tools/bench_compare.py --current out.json
+    python tools/bench_compare.py --run --update         # re-baseline
+
+``--run`` executes ``bench.py --smoke`` on the CPU backend, parses its
+one-line JSON, and compares a fixed table of guarded metrics against
+``bench_baseline_smoke.json`` with per-metric tolerance bands.  Any
+regression beyond its band exits non-zero — wired as ``make
+perfguard`` and the ``perfguard`` tox env, so a PR that quietly erodes
+the pipeline/ingest/sync wins fails CI instead of shipping.
+
+Tolerances are deliberately wide for wall-clock rates (CI machines are
+noisy; a band catches collapses, not jitter) and tight for
+machine-independent ratios and invariants (sync reduction factors,
+zero-loss flags).  Metrics the baseline does not carry are skipped;
+metrics the baseline carries but the current run lost FAIL — silently
+dropping coverage is itself a regression — except sections explicitly
+marked ``skipped`` (optional deps absent on this host).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEFAULT_BASELINE = os.path.join(REPO_ROOT, "bench_baseline_smoke.json")
+
+#: (dotted path, kind, tolerance)
+#: kind "higher" — regression when current < baseline * (1 - tol)
+#: kind "lower"  — regression when current > baseline * (1 + tol)
+#: kind "equal"  — regression when current != baseline
+GUARDS: list[tuple[str, str, float]] = [
+    # headline device rate (wall-clock: generous band)
+    ("value", "higher", 0.60),
+    # pipelined PoW throughput
+    ("configs.batched_queue_mixed.objects_per_s", "higher", 0.60),
+    ("configs.broadcast_storm_small.objects_per_s", "higher", 0.60),
+    # degraded mode must still solve, losslessly
+    ("configs.degraded_fallback.no_object_loss", "equal", 0.0),
+    ("configs.degraded_fallback.objects_per_s", "higher", 0.75),
+    # ingest fast path: end-to-end rate + the pipelined-vs-inline win
+    ("configs.ingest_storm.pipelined.objects_per_s", "higher", 0.60),
+    ("configs.ingest_storm.speedup_vs_inline", "higher", 0.50),
+    # sync: machine-independent bandwidth ratios + the loss invariant
+    ("configs.sync_storm.announce_reduction_x", "higher", 0.30),
+    ("configs.sync_storm.catchup_reduction_x", "higher", 0.30),
+    ("configs.sync_storm.zero_objects_lost", "equal", 0.0),
+    # propagation latency (ticks) may not grow past its band
+    ("configs.sync_storm.propagation_ticks.reconciliation.p99",
+     "lower", 1.00),
+]
+
+
+def dig(d: dict, path: str):
+    """Resolve a dotted path; None when any hop is missing."""
+    cur = d
+    for part in path.split("."):
+        if not isinstance(cur, dict) or part not in cur:
+            return None
+        cur = cur[part]
+    return cur
+
+
+def section_skipped(d: dict, path: str) -> bool:
+    """True when some ancestor dict of ``path`` is marked skipped
+    (optional dependency absent on this host)."""
+    cur = d
+    for part in path.split("."):
+        if not isinstance(cur, dict):
+            return False
+        if "skipped" in cur:
+            return True
+        cur = cur.get(part)
+    return isinstance(cur, dict) and "skipped" in cur
+
+
+def compare(baseline: dict, current: dict,
+            guards=GUARDS) -> tuple[list[str], list[str]]:
+    """Returns (failures, notes) — empty failures means the run holds
+    the baseline."""
+    failures, notes = [], []
+    for path, kind, tol in guards:
+        base = dig(baseline, path)
+        if base is None:
+            notes.append("SKIP  %s (not in baseline)" % path)
+            continue
+        cur = dig(current, path)
+        if cur is None:
+            if section_skipped(current, path):
+                notes.append("SKIP  %s (section skipped on this host)"
+                             % path)
+                continue
+            failures.append("LOST  %s (baseline=%r, missing from this "
+                            "run)" % (path, base))
+            continue
+        if kind == "equal":
+            if cur != base:
+                failures.append("FAIL  %s: %r != baseline %r"
+                                % (path, cur, base))
+            else:
+                notes.append("OK    %s: %r" % (path, cur))
+            continue
+        try:
+            base_f, cur_f = float(base), float(cur)
+        except (TypeError, ValueError):
+            failures.append("FAIL  %s: non-numeric (%r vs %r)"
+                            % (path, cur, base))
+            continue
+        if kind == "higher":
+            floor = base_f * (1.0 - tol)
+            ok = cur_f >= floor
+            detail = "%.4g >= %.4g (baseline %.4g - %d%%)" % (
+                cur_f, floor, base_f, tol * 100)
+        else:
+            ceil = base_f * (1.0 + tol)
+            ok = cur_f <= ceil
+            detail = "%.4g <= %.4g (baseline %.4g + %d%%)" % (
+                cur_f, ceil, base_f, tol * 100)
+        (notes if ok else failures).append(
+            "%s %s: %s" % ("OK   " if ok else "FAIL ", path, detail))
+    return failures, notes
+
+
+def run_bench_smoke() -> dict:
+    """Run ``bench.py --smoke`` on the CPU backend; parse the JSON
+    line (the last stdout line that parses)."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO_ROOT, "bench.py"), "--smoke"],
+        env=env, capture_output=True, text=True, cwd=REPO_ROOT)
+    if proc.returncode != 0:
+        sys.stderr.write(proc.stderr[-4000:])
+        raise SystemExit("bench.py --smoke failed (rc=%d)"
+                         % proc.returncode)
+    for line in reversed(proc.stdout.strip().splitlines()):
+        try:
+            return json.loads(line)
+        except json.JSONDecodeError:
+            continue
+    raise SystemExit("bench.py --smoke emitted no parseable JSON line")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                    help="committed baseline JSON (default: %(default)s)")
+    ap.add_argument("--current", default=None,
+                    help="bench JSON file to compare (instead of --run)")
+    ap.add_argument("--run", action="store_true",
+                    help="run bench.py --smoke and compare its output")
+    ap.add_argument("--update", action="store_true",
+                    help="write the current run over the baseline")
+    args = ap.parse_args(argv)
+
+    if args.run:
+        current = run_bench_smoke()
+    elif args.current:
+        with open(args.current) as f:
+            current = json.load(f)
+    else:
+        ap.error("one of --run / --current is required")
+
+    # the baseline keeps only what the guards read (plus provenance) —
+    # a full metrics_snapshot would churn every re-baseline diff
+    if args.update:
+        slim: dict = {"_provenance": {
+            "tool": "tools/bench_compare.py --update",
+            "kernel": current.get("kernel"),
+            "smoke": current.get("smoke", False)}}
+        for path, _, _ in GUARDS:
+            val = dig(current, path)
+            if val is None:
+                continue
+            cur = slim
+            parts = path.split(".")
+            for part in parts[:-1]:
+                cur = cur.setdefault(part, {})
+            cur[parts[-1]] = val
+        with open(args.baseline, "w") as f:
+            json.dump(slim, f, indent=2, sort_keys=True)
+            f.write("\n")
+        print("perfguard: baseline updated -> %s" % args.baseline)
+        return 0
+
+    try:
+        with open(args.baseline) as f:
+            baseline = json.load(f)
+    except FileNotFoundError:
+        sys.stderr.write(
+            "perfguard: no baseline at %s (generate one with "
+            "--run --update)\n" % args.baseline)
+        return 2
+
+    failures, notes = compare(baseline, current)
+    for line in notes:
+        print(line)
+    for line in failures:
+        print(line)
+    if failures:
+        print("perfguard: %d regression(s) vs %s"
+              % (len(failures), os.path.basename(args.baseline)))
+        return 1
+    print("perfguard: all %d guarded metrics within tolerance"
+          % len([n for n in notes if not n.startswith("SKIP")]))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
