@@ -8,6 +8,7 @@ all: native
 
 native:
 	$(MAKE) -C native/pow
+	$(MAKE) -C native/secp256k1
 
 test: native
 	python -m pytest tests/ -q
@@ -42,4 +43,5 @@ perfguard:
 
 clean:
 	$(MAKE) -C native/pow clean
+	$(MAKE) -C native/secp256k1 clean
 	find . -name __pycache__ -type d -exec rm -rf {} +

@@ -129,6 +129,20 @@ async def run(args) -> int:
     node.processor.concurrency = settings.getint("ingestworkers")
     if settings.getint("cryptoworkers"):
         node.processor.crypto.size = settings.getint("cryptoworkers")
+    # batched native crypto knobs (docs/ingest.md) — applied before
+    # start() spawns the engine's drain task.  cryptonative=false is
+    # the process-wide switch (set_native_enabled), not just an engine
+    # flag: the per-call signing/ecies ladder must honor it too, even
+    # with the batch engine off
+    from .crypto.native import set_native_enabled
+    set_native_enabled(settings.getbool("cryptonative"))
+    if not settings.getbool("cryptobatch"):
+        node.processor.crypto.batch = None
+    elif node.processor.crypto.batch is not None:
+        engine = node.processor.crypto.batch
+        engine.use_native = settings.getbool("cryptonative")
+        engine.window = settings.getfloat("cryptobatchwindow")
+        engine.num_threads = settings.getint("cryptonativethreads")
     queue = node.ctx.object_queue
     if hasattr(queue, "high"):
         queue.high = settings.getint("ingestqueuehigh")

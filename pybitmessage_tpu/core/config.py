@@ -87,6 +87,19 @@ DEFAULTS: dict[str, str] = {
     "ingestqueuehigh": "512",        # object-queue high watermark
                                      # pausing connection reads
                                      # (0 = never pause)
+    # -- batched native crypto (docs/ingest.md) --
+    "cryptobatch": "true",           # coalescing batch dispatcher for
+                                     # decrypt/sig-verify (off = the
+                                     # per-call pool path)
+    "cryptonative": "true",          # allow the native secp256k1
+                                     # batch tier (off = pure path)
+    "cryptobatchwindow": "0.0",      # batch coalescing window, seconds
+                                     # (0 = drain immediately; batching
+                                     # emerges from load)
+    "cryptonativethreads": "1",      # std::thread fan-out inside each
+                                     # native batch call (raise on
+                                     # wide hosts; 0 = all hardware
+                                     # threads)
     # -- set-reconciliation sync (docs/sync.md) --
     "syncenabled": "true",           # sketch-based inventory sync
                                      # (negotiated; old peers keep
@@ -170,6 +183,10 @@ VALIDATORS: dict[str, Callable[[str], bool]] = {
     "ingestworkers": _validate_int_range(1, 256),
     "cryptoworkers": _validate_int_range(0, 256),
     "ingestqueuehigh": _validate_int_range(0, 1 << 20),
+    "cryptobatch": _validate_bool,
+    "cryptonative": _validate_bool,
+    "cryptobatchwindow": _validate_float_range(0.0, 10.0),
+    "cryptonativethreads": _validate_int_range(0, 256),
     "syncenabled": _validate_bool,
     "syncinterval": _validate_float_range(0.5, 3600.0),
     "syncfanout": _validate_int_range(-1, 1000),

@@ -1,7 +1,13 @@
 """secp256k1 cryptography: ECIES, ECDSA, key management.
 
-A clean-room Python-3 implementation over the ``cryptography`` library
-(OpenSSL-backed) of the wire formats the Bitmessage network requires:
+A clean-room Python-3 implementation of the wire formats the
+Bitmessage network requires, over a three-tier backend ladder
+(mirroring the PoW solver ladder): the OpenSSL-backed ``cryptography``
+package where installed, the native batch engine
+(``native/secp256k1/`` via ``crypto/native.py``), and the pure-Python
+tier (``crypto/fallback.py``) everywhere.  Receive-side hot paths
+additionally coalesce into batch drains (``crypto/batch.py``,
+docs/ingest.md):
 
 - ECIES (reference behavior: src/pyelliptic/ecc.py:461-501): ephemeral
   secp256k1 key -> ECDH raw X coordinate -> SHA512 KDF -> AES-256-CBC

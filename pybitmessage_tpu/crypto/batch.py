@@ -1,0 +1,500 @@
+"""Coalescing batch dispatcher for receive-side crypto (ISSUE 7).
+
+Every ECDSA check and ECIES trial decryption used to run as an
+individual call fanned over a thread pool.  This engine applies the
+drain-window pattern that paid off for PoW verification
+(pow/verify_service.py) to secp256k1: whatever checks accumulated
+while the previous batch was in flight — across objects AND
+connections — become the next batch, one executor hop and one
+GIL-releasing native call per drain, ``std::thread`` fan-out across
+items inside the library (native/secp256k1/).
+
+Tiers, breaker-supervised like the PoW ladder (pow/dispatcher.py):
+
+1. **native** — ``tpu_secp_verify_batch`` for ECDSA (scalar prep
+   u1 = e/s, u2 = r/s stays in Python; digest order follows the
+   per-pubkey hint table in ``crypto/signing.py``) and
+   ``tpu_secp_ecdh_batch`` for ECIES, which fans one object's
+   ephemeral point across candidate identity scalars.  Trial decrypts
+   scan candidates in WAVEFRONT rounds — round k computes ECDH for
+   the k-th candidate of every still-unmatched object in one call —
+   so the batch path keeps the sequential path's first-match
+   early-exit (an object is encrypted to exactly one key) while
+   amortizing calls across objects.  MAC-first rejection: AES runs
+   only for the one real match.
+2. **pure** — the per-item ``crypto.signing`` / ``crypto.ecies``
+   ladder (OpenSSL-backed ``cryptography`` when installed, else
+   pure Python), fanned across a small thread pool.  Entered when the
+   native library is unbuilt, its breaker is open, or the attempt
+   raises — including the ``crypto.native`` chaos site — and counted
+   in ``crypto_native_fallback_total``.  No check is ever lost to a
+   native failure.
+
+Parity between the tiers is property-tested bit-for-bit
+(tests/test_crypto_batch.py).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Sequence
+
+from ..observability import DEFAULT_SIZE_BUCKETS, REGISTRY
+from ..resilience import CircuitBreaker, inject
+from ..resilience.policy import ERRORS
+from . import fallback
+from .signing import _HASHERS, digest_order, note_digest
+
+logger = logging.getLogger("pybitmessage_tpu.crypto")
+
+BATCH_SIZE = REGISTRY.histogram(
+    "crypto_batch_size",
+    "Items per coalesced crypto drain (verify: signature checks; "
+    "ecdh: candidate scalars across all trial-decrypt objects)",
+    ("op",), buckets=DEFAULT_SIZE_BUCKETS)
+BATCH_SECONDS = REGISTRY.histogram(
+    "crypto_batch_seconds",
+    "Wall time of one drain's work per op (native call + scalar prep "
+    "+ MAC sweep), excluding coalesce wait — the batch-path analog of "
+    "the per-call ingest_stage_seconds decrypt/sig_verify stages",
+    ("op",))
+BATCH_OPS = REGISTRY.counter(
+    "crypto_batch_ops_total",
+    "Batched crypto items by op and execution path", ("op", "path"))
+NATIVE_FALLBACKS = REGISTRY.counter(
+    "crypto_native_fallback_total",
+    "Drains whose native batch attempt failed and re-ran on the pure "
+    "per-item tier (breaker-counted; no check is lost)")
+SHUTDOWN_SETTLED = REGISTRY.counter(
+    "crypto_batch_shutdown_settled_total",
+    "Checks still pending at engine shutdown, settled deterministically "
+    "(verify False / decrypt no-match) instead of leaking "
+    "CancelledError into the ingest workers")
+
+_N = fallback.N
+
+
+class _VerifyJob:
+    __slots__ = ("data", "sig", "pub", "fut")
+
+    def __init__(self, data, sig, pub, fut):
+        self.data, self.sig, self.pub, self.fut = data, sig, pub, fut
+
+
+class _DecryptJob:
+    __slots__ = ("payload", "candidates", "fut")
+
+    def __init__(self, payload, candidates, fut):
+        self.payload, self.candidates, self.fut = payload, candidates, fut
+
+
+class BatchCryptoEngine:
+    """Coalesces verify / trial-decrypt calls into native batch drains.
+
+    ``window`` mirrors ``BatchVerifier``: 0 in production (batching
+    emerges from load with zero added latency); a positive value
+    sleeps after the first item to grow the batch — bench/test use
+    only.  ``use_native=False`` pins the engine to the pure tier (the
+    coalescing still amortizes executor hops and payload parses).
+
+    ``num_threads`` is the fan-out inside each native call.  Default 1:
+    the batch wins (one Montgomery inversion per drain, one call per
+    drain, amortized parses) are load-independent, while std::thread
+    fan-out only pays off when spare cores actually exist — on a
+    2-core box the event loop and ingest workers already own them.
+    Raise it on wide hosts.
+    """
+
+    def __init__(self, *, use_native: bool = True, window: float = 0.0,
+                 num_threads: int = 1,
+                 breaker: CircuitBreaker | None = None):
+        self.use_native = use_native
+        self.window = window
+        self.num_threads = num_threads
+        self.queue: asyncio.Queue = asyncio.Queue()
+        self.breaker = breaker or CircuitBreaker(
+            "crypto.native", threshold=3, cooldown=60.0)
+        self._task: asyncio.Task | None = None
+        self._exec: ThreadPoolExecutor | None = None
+        self._fan: ThreadPoolExecutor | None = None
+        self._lock = threading.Lock()
+        #: observability: items down each path
+        self.native_items = 0
+        self.pure_items = 0
+
+    # -- lifecycle -----------------------------------------------------------
+
+    @property
+    def running(self) -> bool:
+        return self._task is not None and not self._task.done()
+
+    def start(self) -> asyncio.Task:
+        if self.use_native:
+            # warm the library on the dispatch thread: the first
+            # get_native() may auto-`make` (seconds of compile) and
+            # must not run on the event loop — loading here means
+            # loop-side callers (keystore, API) find it ready
+            self._executor().submit(self._native_engine)
+        self._task = asyncio.create_task(self._run())
+        return self._task
+
+    async def stop(self) -> None:
+        if self._task:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+        # settle still-queued checks deterministically (the
+        # BatchVerifier shutdown contract): a pending verify resolves
+        # False, a pending decrypt resolves no-match — never a
+        # CancelledError leaking into per-object ingest workers
+        while not self.queue.empty():
+            self._settle(self.queue.get_nowait())
+        with self._lock:
+            if self._exec is not None:
+                self._exec.shutdown(wait=False, cancel_futures=True)
+                self._exec = None
+            if self._fan is not None:
+                self._fan.shutdown(wait=False, cancel_futures=True)
+                self._fan = None
+
+    @staticmethod
+    def _settle(job, *, shutdown: bool = True) -> None:
+        """Resolve a pending check conservatively (verify False /
+        decrypt no-match).  Only shutdown-time settlements count into
+        the shutdown counter — drain failures are already counted at
+        their ERRORS site."""
+        if not job.fut.done():
+            if shutdown:
+                SHUTDOWN_SETTLED.inc()
+            job.fut.set_result(
+                False if isinstance(job, _VerifyJob) else [])
+
+    def _executor(self) -> ThreadPoolExecutor:
+        with self._lock:
+            if self._exec is None:
+                self._exec = ThreadPoolExecutor(
+                    max_workers=1,
+                    thread_name_prefix="bmtpu-cryptobatch")
+            return self._exec
+
+    def _fanout(self) -> ThreadPoolExecutor:
+        """Small pool the PURE tier fans per-item work across (the
+        native tier threads inside the library instead)."""
+        with self._lock:
+            if self._fan is None:
+                self._fan = ThreadPoolExecutor(
+                    max_workers=max(2, min(8, os.cpu_count() or 2)),
+                    thread_name_prefix="bmtpu-cryptofan")
+            return self._fan
+
+    # -- public API ----------------------------------------------------------
+
+    async def verify(self, data: bytes, signature: bytes,
+                     pubkey: bytes) -> bool:
+        """One ECDSA acceptance check, coalesced (never raises)."""
+        fut = asyncio.get_running_loop().create_future()
+        await self.queue.put(_VerifyJob(data, signature, pubkey, fut))
+        return await fut
+
+    async def try_decrypt(
+            self, payload: bytes,
+            candidates: Sequence[tuple[bytes, object]],
+    ) -> list[tuple[bytes, object]]:
+        """ECIES trial-decrypt one object against candidate keys,
+        coalesced with other objects' sweeps.  Returns the (usually 0-
+        or 1-element) ``(plaintext, handle)`` match list, preserving
+        the caller's candidate order semantics (first match wins)."""
+        candidates = list(candidates)
+        if not candidates:
+            return []
+        fut = asyncio.get_running_loop().create_future()
+        await self.queue.put(_DecryptJob(payload, candidates, fut))
+        return await fut
+
+    # -- drain loop ----------------------------------------------------------
+
+    async def _run(self) -> None:
+        while True:
+            batch: list = []
+            try:
+                batch.append(await self.queue.get())
+                if self.window > 0:
+                    await asyncio.sleep(self.window)
+                while not self.queue.empty():
+                    batch.append(self.queue.get_nowait())
+                verifies = [j for j in batch
+                            if isinstance(j, _VerifyJob)]
+                decrypts = [j for j in batch
+                            if isinstance(j, _DecryptJob)]
+                loop = asyncio.get_running_loop()
+                v_res, d_res = await loop.run_in_executor(
+                    self._executor(), self._execute, verifies, decrypts)
+                for job, ok in zip(verifies, v_res):
+                    if not job.fut.done():
+                        job.fut.set_result(ok)
+                for job, matches in zip(decrypts, d_res):
+                    if not job.fut.done():
+                        job.fut.set_result(matches)
+            except asyncio.CancelledError:
+                for job in batch:
+                    self._settle(job)
+                raise
+            except Exception:
+                # a drain must never wedge its callers: settle the
+                # whole batch conservatively and keep draining
+                ERRORS.labels(site="crypto.batch").inc()
+                logger.exception("crypto batch drain failed; batch "
+                                 "settled unverified/no-match")
+                for job in batch:
+                    self._settle(job, shutdown=False)
+
+    # -- execution (worker thread) -------------------------------------------
+
+    def _native_engine(self):
+        if not self.use_native:
+            return None
+        from .native import get_native
+        native = get_native()
+        return native if native.available else None
+
+    def _execute(self, verifies, decrypts):
+        """One drain's work; returns (verify bools, decrypt matches).
+
+        Runs on the dispatch thread — the native tier releases the GIL
+        for the whole batch, the pure tier fans across ``_fanout``.
+        """
+        native = self._native_engine()
+        path = "pure"
+        if native is not None and self.breaker.allow():
+            try:
+                inject("crypto.native")
+                t0 = time.monotonic()
+                v_res = self._native_verify(native, verifies)
+                tv = time.monotonic()
+                d_res = self._native_decrypt(native, decrypts)
+                if verifies:
+                    BATCH_SECONDS.labels(op="verify").observe(
+                        tv - t0)
+                if decrypts:
+                    BATCH_SECONDS.labels(op="decrypt").observe(
+                        time.monotonic() - tv)
+                self.breaker.record_success()
+                self.native_items += len(verifies) + len(decrypts)
+                self._count(verifies, decrypts, "native")
+                return v_res, d_res
+            except Exception:
+                self.breaker.record_failure()
+                ERRORS.labels(site="crypto.native").inc()
+                NATIVE_FALLBACKS.inc()
+                logger.exception(
+                    "native crypto batch failed; re-running drain on "
+                    "the pure per-item tier")
+        t0 = time.monotonic()
+        v_res = self._pure_verify(verifies)
+        tv = time.monotonic()
+        d_res = self._pure_decrypt(decrypts)
+        if verifies:
+            BATCH_SECONDS.labels(op="verify").observe(tv - t0)
+        if decrypts:
+            BATCH_SECONDS.labels(op="decrypt").observe(
+                time.monotonic() - tv)
+        self.pure_items += len(verifies) + len(decrypts)
+        self._count(verifies, decrypts, path)
+        return v_res, d_res
+
+    @staticmethod
+    def _count(verifies, decrypts, path: str) -> None:
+        if verifies:
+            BATCH_SIZE.labels(op="verify").observe(len(verifies))
+            BATCH_OPS.labels(op="verify", path=path).inc(len(verifies))
+        if decrypts:
+            fan = sum(len(j.candidates) for j in decrypts)
+            BATCH_SIZE.labels(op="ecdh").observe(fan)
+            BATCH_OPS.labels(op="decrypt", path=path).inc(len(decrypts))
+
+    # -- native tier ---------------------------------------------------------
+
+    @staticmethod
+    def _prep_sigs(verifies):
+        """Digest-independent parse of every signature in the drain:
+        per item (point64, r, s_inv) or None.  The s-inversions mod n
+        collapse into ONE ``pow(-1)`` via the Montgomery product trick
+        (the same batch-inversion shape the native library applies to
+        the Jacobian Z coordinates) — a per-signature ~30 us field
+        inversion becomes two multiplications."""
+        from .keys import pub_point64
+        parsed: list = []
+        for job in verifies:
+            try:
+                point = pub_point64(job.pub)
+                r, s = fallback.der_decode_sig(job.sig)
+            except ValueError:
+                parsed.append(None)
+                continue
+            if not (0 < r < _N and 0 < s < _N):
+                parsed.append(None)
+                continue
+            parsed.append((point, r, s))
+        prefix, acc = [], 1
+        for item in parsed:
+            if item is None:
+                continue
+            prefix.append(acc)
+            acc = (acc * item[2]) % _N
+        if not prefix:
+            return parsed
+        inv = pow(acc, -1, _N)
+        out: list = [None] * len(parsed)
+        k = len(prefix) - 1
+        for i in range(len(parsed) - 1, -1, -1):
+            if parsed[i] is None:
+                continue
+            point, r, s = parsed[i]
+            s_inv = (inv * prefix[k]) % _N
+            inv = (inv * s) % _N
+            out[i] = (point, r, s_inv)
+            k -= 1
+        return out
+
+    def _native_verify(self, native, verifies) -> list[bool]:
+        """Batch ECDSA with hinted-digest rounds: round 1 tries each
+        item's preferred digest; only misses re-enter round 2 with the
+        alternate — legacy-SHA1 peers stop paying a doomed SHA256
+        scalar multiplication once the hint table warms."""
+        results = [False] * len(verifies)
+        if not verifies:
+            return results
+        prepped = self._prep_sigs(verifies)
+        orders = [digest_order(j.pub) for j in verifies]
+        #: (item index, digest) still to attempt, per round
+        live = [(i, 0) for i in range(len(verifies))
+                if prepped[i] is not None]
+        while live:
+            u1s, u2s, pubs, rs, idx = [], [], [], [], []
+            for i, d_i in live:
+                point, r, s_inv = prepped[i]
+                digest = orders[i][d_i]
+                e = fallback.digest_to_scalar(
+                    _HASHERS[digest](verifies[i].data).digest())
+                u1s.append(((e * s_inv) % _N).to_bytes(32, "big"))
+                u2s.append(((r * s_inv) % _N).to_bytes(32, "big"))
+                pubs.append(point)
+                rs.append(r.to_bytes(32, "big"))
+                idx.append((i, d_i))
+            ok = native.verify_prepared(
+                len(idx), b"".join(u1s), b"".join(u2s),
+                b"".join(pubs), b"".join(rs),
+                nthreads=self.num_threads)
+            nxt = []
+            for (i, d_i), hit in zip(idx, ok):
+                if hit:
+                    results[i] = True
+                    note_digest(verifies[i].pub, orders[i][d_i],
+                                fallback=d_i > 0)
+                elif d_i + 1 < len(orders[i]):
+                    nxt.append((i, d_i + 1))
+            live = nxt
+        return results
+
+    def _native_decrypt(self, native, decrypts):
+        """Wavefront trial decryption: round k computes ECDH for the
+        k-th candidate of every still-unmatched object in ONE native
+        call, then MAC-checks; AES runs only for the real match."""
+        from . import ecies
+        from .keys import priv_scalar32
+        results: list[list] = [[] for _ in decrypts]
+        parsed = []
+        live = []
+        for i, job in enumerate(decrypts):
+            try:
+                pp = ecies.parse_payload(job.payload)
+            except ValueError:
+                parsed.append(None)
+                continue
+            parsed.append(pp)
+            live.append(i)
+        rnd = 0
+        while live:
+            points, scalars, idx = [], [], []
+            for i in live:
+                priv, _handle = decrypts[i].candidates[rnd]
+                try:
+                    scalar = priv_scalar32(priv)
+                except ValueError:
+                    continue            # invalid key: a miss
+                points.append(parsed[i].ephem_pub[1:])
+                scalars.append(scalar)
+                idx.append(i)
+            if idx:
+                xs = native.ecdh_batch(len(idx), b"".join(points),
+                                       b"".join(scalars),
+                                       nthreads=self.num_threads)
+            else:
+                xs = []
+            nxt = set(live)
+            for i, x in zip(idx, xs):
+                if x is None:
+                    continue
+                pp = parsed[i]
+                key_e, key_m = ecies.kdf(x)
+                if not ecies.mac_ok(key_m, pp.macdata, pp.tag):
+                    continue
+                try:
+                    plain = ecies.finish_decrypt(key_e, pp)
+                except ValueError:
+                    continue            # MAC-approved but unpaddable
+                results[i].append((plain,
+                                   decrypts[i].candidates[rnd][1]))
+                nxt.discard(i)          # first match wins; stop sweep
+            rnd += 1
+            live = [i for i in nxt
+                    if rnd < len(decrypts[i].candidates)]
+        return results
+
+    # -- pure tier -----------------------------------------------------------
+
+    def _map(self, fn, items):
+        """Fan ``fn`` over items on the pure-tier pool (ordered)."""
+        if len(items) <= 1:
+            return [fn(item) for item in items]
+        return list(self._fanout().map(fn, items))
+
+    def _pure_verify(self, verifies) -> list[bool]:
+        # allow_native=False: this tier is the refuge from a native
+        # failure (or use_native=False pin) — the per-item ladder must
+        # not re-enter the library whose drain just failed
+        from .signing import verify as _verify
+        return self._map(
+            lambda j: bool(_verify(j.data, j.sig, j.pub,
+                                   allow_native=False)), verifies)
+
+    def _pure_decrypt(self, decrypts):
+        from . import ecies
+
+        def sweep(job):
+            try:
+                pp = ecies.parse_payload(job.payload)
+            except ValueError:
+                return []
+            for priv, handle in job.candidates:
+                try:
+                    key_e, key_m = ecies.kdf(
+                        ecies.ecdh_raw(priv, pp.ephem_pub,
+                                       allow_native=False))
+                    if not ecies.mac_ok(key_m, pp.macdata, pp.tag):
+                        continue
+                    return [(ecies.finish_decrypt(
+                        key_e, pp, allow_native=False), handle)]
+                except ValueError:
+                    continue            # bad key/point: a miss
+            return []
+
+        return self._map(sweep, decrypts)

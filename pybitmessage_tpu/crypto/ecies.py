@@ -7,65 +7,58 @@ docs, encrypted payload):
 
 KDF: key = SHA512(ECDH_raw_x); key_e = key[:32] (AES), key_m = key[32:]
 (HMAC-SHA256).  MAC covers everything before it.  MAC is verified in
-constant time BEFORE decryption (reference: ecc.py:497 via
-pyelliptic/hash.py equals).
+constant time (``hmac.compare_digest``) BEFORE decryption (reference:
+ecc.py:497 via pyelliptic/hash.py equals) — AES runs only for the one
+real recipient, which is also what makes batched trial decryption
+cheap: the per-candidate cost is one ECDH + one HMAC, never AES.
+
+The payload parse / KDF / MAC / AES stages are exposed as module
+helpers so the batch crypto engine (crypto/batch.py) can fan ONE
+object's ephemeral point across many candidate scalars in a single
+native call and then reuse the exact same MAC-first rejection this
+module applies per call — parity between the paths is property-tested.
 """
 
 from __future__ import annotations
 
 import hmac as hmac_mod
 import os
-from hashlib import sha512
-
-from cryptography.hazmat.primitives import hashes, hmac, padding
-from cryptography.hazmat.primitives.asymmetric import ec
-from cryptography.hazmat.primitives.ciphers import Cipher, algorithms, modes
+from hashlib import sha256, sha512
+from typing import NamedTuple
 
 from .keys import (
-    _priv_obj, decode_pubkey_wire, encode_pubkey_wire, priv_to_pub, pub_obj,
-    random_private_key,
+    decode_pubkey_wire, encode_pubkey_wire, have_openssl, priv_scalar32,
+    priv_to_pub, pub_point64, random_private_key,
 )
+
+if have_openssl():
+    from cryptography.hazmat.primitives.asymmetric import ec
+    from cryptography.hazmat.primitives.ciphers import (
+        Cipher, algorithms, modes,
+    )
+
+    from .keys import _priv_obj, pub_obj
 
 
 class DecryptionError(ValueError):
     """MAC mismatch or malformed payload — indistinguishable on purpose."""
 
 
-def _derive_keys(privkey: bytes, peer_pub: bytes) -> tuple[bytes, bytes]:
-    """ECDH -> SHA512 KDF -> (aes_key, mac_key).
-
-    ``cryptography``'s ECDH exchange returns the raw X coordinate padded
-    to the field size — identical to OpenSSL's ECDH_compute_key with no
-    KDF, which is what the reference hashes (ecc.py:201, 243-247).
-    """
-    shared = _priv_obj(privkey).exchange(ec.ECDH(), pub_obj(peer_pub))
-    key = sha512(shared).digest()
-    return key[:32], key[32:]
+class ParsedPayload(NamedTuple):
+    """One ECIES payload split into its wire fields; ``macdata`` is the
+    MAC's coverage (everything before the tag)."""
+    iv: bytes
+    ephem_pub: bytes        # 65-byte uncompressed point
+    ciphertext: bytes
+    tag: bytes
+    macdata: bytes
 
 
-def encrypt(data: bytes, recipient_pubkey: bytes) -> bytes:
-    """Encrypt to a 65-byte uncompressed secp256k1 public key."""
-    ephem_priv = random_private_key()
-    key_e, key_m = _derive_keys(ephem_priv, recipient_pubkey)
-
-    iv = os.urandom(16)
-    padder = padding.PKCS7(128).padder()
-    padded = padder.update(data) + padder.finalize()
-    enc = Cipher(algorithms.AES(key_e), modes.CBC(iv)).encryptor()
-    ct = enc.update(padded) + enc.finalize()
-
-    blob = iv + encode_pubkey_wire(priv_to_pub(ephem_priv)) + ct
-    mac = hmac.HMAC(key_m, hashes.SHA256())
-    mac.update(blob)
-    return blob + mac.finalize()
-
-
-def decrypt(payload: bytes, privkey: bytes) -> bytes:
-    """Decrypt an ECIES payload with a 32-byte private key.
-
-    Raises :class:`DecryptionError` on any malformation or MAC failure
-    (one exception type so callers can't leak which check failed).
-    """
+def parse_payload(payload: bytes) -> ParsedPayload:
+    """Split ``payload`` into fields; raises :class:`DecryptionError`
+    on any malformation (truncation, bad curve tag, empty or ragged
+    ciphertext) — one exception type so callers can't leak which check
+    failed."""
     try:
         if len(payload) < 16 + 6 + 16 + 32:
             raise ValueError("payload too short")
@@ -75,18 +68,116 @@ def decrypt(payload: bytes, privkey: bytes) -> bytes:
         tag = payload[len(payload) - 32:]
         if len(ct) == 0 or len(ct) % 16:
             raise ValueError("bad ciphertext length")
+        return ParsedPayload(iv, ephem_pub, ct, tag,
+                             payload[:len(payload) - 32])
+    except DecryptionError:
+        raise
+    except Exception as exc:
+        raise DecryptionError("decryption failed") from exc
 
-        key_e, key_m = _derive_keys(privkey, ephem_pub)
-        mac = hmac.HMAC(key_m, hashes.SHA256())
-        mac.update(payload[:len(payload) - 32])
-        expect = mac.finalize()
-        if not hmac_mod.compare_digest(expect, tag):
+
+def kdf(shared_x: bytes) -> tuple[bytes, bytes]:
+    """SHA512 KDF over the raw ECDH X -> (aes_key, mac_key)."""
+    key = sha512(shared_x).digest()
+    return key[:32], key[32:]
+
+
+def mac_ok(mac_key: bytes, macdata: bytes, tag: bytes) -> bool:
+    """Constant-time HMAC-SHA256 acceptance (``hmac.compare_digest``)."""
+    expect = hmac_mod.new(mac_key, macdata, sha256).digest()
+    return hmac_mod.compare_digest(expect, tag)
+
+
+def ecdh_raw(privkey: bytes, peer_pub: bytes, *,
+             allow_native: bool = True) -> bytes:
+    """Raw ECDH X coordinate, padded to the field size — identical to
+    OpenSSL's ECDH_compute_key with no KDF, which is what the
+    reference hashes (ecc.py:201, 243-247).  Backend ladder: OpenSSL
+    -> native engine -> pure Python.  ``allow_native=False`` skips the
+    native rung — the batch engine's fallback tier must not re-enter
+    the library whose drain just failed."""
+    if have_openssl():
+        return _priv_obj(privkey).exchange(ec.ECDH(), pub_obj(peer_pub))
+    if allow_native:
+        from .native import get_native
+        native = get_native()
+        if native.available:
+            out = native.ecdh_batch(1, pub_point64(peer_pub),
+                                    priv_scalar32(privkey))[0]
+            if out is None:
+                raise ValueError("invalid ECDH operands")
+            return out
+    from . import fallback
+    return fallback.ecdh_x(privkey, peer_pub)
+
+
+def _aes256_cbc(encrypt: bool, key: bytes, iv: bytes,
+                data: bytes, *, allow_native: bool = True) -> bytes:
+    if have_openssl():
+        cipher = Cipher(algorithms.AES(key), modes.CBC(iv))
+        op = cipher.encryptor() if encrypt else cipher.decryptor()
+        return op.update(data) + op.finalize()
+    if allow_native:
+        from .native import get_native
+        native = get_native()
+        if native.available:
+            return native.aes256_cbc(encrypt, key, iv, data)
+    from . import fallback
+    return fallback.aes256_cbc(encrypt, key, iv, data)
+
+
+def _pkcs7_pad(data: bytes) -> bytes:
+    n = 16 - len(data) % 16
+    return data + bytes([n]) * n
+
+
+def _pkcs7_unpad(data: bytes) -> bytes:
+    if not data or len(data) % 16:
+        raise ValueError("bad padded length")
+    n = data[-1]
+    if not 1 <= n <= 16 or data[-n:] != bytes([n]) * n:
+        raise ValueError("bad PKCS7 padding")
+    return data[:-n]
+
+
+def finish_decrypt(aes_key: bytes, parsed: ParsedPayload, *,
+                   allow_native: bool = True) -> bytes:
+    """AES-decrypt + unpad a MAC-approved payload."""
+    padded = _aes256_cbc(False, aes_key, parsed.iv, parsed.ciphertext,
+                         allow_native=allow_native)
+    return _pkcs7_unpad(padded)
+
+
+def _derive_keys(privkey: bytes, peer_pub: bytes) -> tuple[bytes, bytes]:
+    """ECDH -> SHA512 KDF -> (aes_key, mac_key)."""
+    return kdf(ecdh_raw(privkey, peer_pub))
+
+
+def encrypt(data: bytes, recipient_pubkey: bytes) -> bytes:
+    """Encrypt to a 65-byte uncompressed secp256k1 public key."""
+    ephem_priv = random_private_key()
+    key_e, key_m = _derive_keys(ephem_priv, recipient_pubkey)
+
+    iv = os.urandom(16)
+    ct = _aes256_cbc(True, key_e, iv, _pkcs7_pad(data))
+
+    blob = iv + encode_pubkey_wire(priv_to_pub(ephem_priv)) + ct
+    mac = hmac_mod.new(key_m, blob, sha256)
+    return blob + mac.digest()
+
+
+def decrypt(payload: bytes, privkey: bytes) -> bytes:
+    """Decrypt an ECIES payload with a 32-byte private key.
+
+    Raises :class:`DecryptionError` on any malformation or MAC failure
+    (one exception type so callers can't leak which check failed).
+    """
+    parsed = parse_payload(payload)
+    try:
+        key_e, key_m = _derive_keys(privkey, parsed.ephem_pub)
+        if not mac_ok(key_m, parsed.macdata, parsed.tag):
             raise ValueError("MAC mismatch")
-
-        dec = Cipher(algorithms.AES(key_e), modes.CBC(iv)).decryptor()
-        padded = dec.update(ct) + dec.finalize()
-        unpadder = padding.PKCS7(128).unpadder()
-        return unpadder.update(padded) + unpadder.finalize()
+        return finish_decrypt(key_e, parsed)
     except DecryptionError:
         raise
     except Exception as exc:

@@ -1,4 +1,12 @@
-"""secp256k1 key management and wire formats."""
+"""secp256k1 key management and wire formats.
+
+Backend ladder (mirroring the PoW solver ladder, pow/dispatcher.py):
+the OpenSSL-backed ``cryptography`` package when installed, the native
+batch engine (crypto/native.py) for point arithmetic when built, and
+the pure-Python tier (crypto/fallback.py) always.  Minimal images may
+carry neither OpenSSL wheel nor C++ toolchain; every key operation
+still works there.
+"""
 
 from __future__ import annotations
 
@@ -6,10 +14,14 @@ import functools
 import hashlib
 import secrets
 
-from cryptography.hazmat.primitives.asymmetric import ec
-from cryptography.hazmat.primitives.serialization import (
-    Encoding, PublicFormat,
-)
+try:
+    from cryptography.hazmat.primitives.asymmetric import ec
+    from cryptography.hazmat.primitives.serialization import (
+        Encoding, PublicFormat,
+    )
+    _HAVE_OPENSSL = True
+except ImportError:          # minimal image: native/python tiers serve
+    _HAVE_OPENSSL = False
 
 from ..utils.base58 import b58decode, b58encode
 from ..utils.varint import encode_varint
@@ -21,7 +33,7 @@ CURVE_TAG = 714
 #: secp256k1 group order (SEC2); private keys must be in [1, N-1].
 _ORDER = 0xFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFEBAAEDCE6AF48A03BBFD25E8CD0364141
 
-_CURVE = ec.SECP256K1()
+_CURVE = ec.SECP256K1() if _HAVE_OPENSSL else None
 
 #: parsed-key-object cache switch.  ``derive_private_key`` performs a
 #: full scalar multiplication per call; the ingest fast path trial-
@@ -33,34 +45,106 @@ _CURVE = ec.SECP256K1()
 _CACHE_ENABLED = True
 
 
+def have_openssl() -> bool:
+    """True when the optional ``cryptography`` package is importable."""
+    return _HAVE_OPENSSL
+
+
 def set_key_cache(enabled: bool) -> None:
     if not enabled:
-        _priv_obj_cached.cache_clear()
-        _pub_obj_cached.cache_clear()
+        if _HAVE_OPENSSL:
+            _priv_obj_cached.cache_clear()
+            _pub_obj_cached.cache_clear()
+        _pub_point64_cached.cache_clear()
+        _priv_scalar32_cached.cache_clear()
     globals()["_CACHE_ENABLED"] = bool(enabled)
 
 
-@functools.lru_cache(maxsize=1024)
-def _priv_obj_cached(privkey: bytes) -> ec.EllipticCurvePrivateKey:
-    return ec.derive_private_key(int.from_bytes(privkey, "big"), _CURVE)
+if _HAVE_OPENSSL:
+    @functools.lru_cache(maxsize=1024)
+    def _priv_obj_cached(privkey: bytes) -> "ec.EllipticCurvePrivateKey":
+        return ec.derive_private_key(int.from_bytes(privkey, "big"),
+                                     _CURVE)
+
+    @functools.lru_cache(maxsize=1024)
+    def _pub_obj_cached(pubkey: bytes) -> "ec.EllipticCurvePublicKey":
+        return ec.EllipticCurvePublicKey.from_encoded_point(_CURVE, pubkey)
 
 
-@functools.lru_cache(maxsize=1024)
-def _pub_obj_cached(pubkey: bytes) -> ec.EllipticCurvePublicKey:
-    return ec.EllipticCurvePublicKey.from_encoded_point(_CURVE, pubkey)
-
-
-def _priv_obj(privkey: bytes) -> ec.EllipticCurvePrivateKey:
+def _priv_obj(privkey: bytes):
+    if not _HAVE_OPENSSL:
+        raise RuntimeError("cryptography not installed")
     if _CACHE_ENABLED:
         return _priv_obj_cached(privkey)
     return ec.derive_private_key(int.from_bytes(privkey, "big"), _CURVE)
 
 
-def pub_obj(pubkey: bytes) -> ec.EllipticCurvePublicKey:
+def pub_obj(pubkey: bytes):
     """Build a public-key object from a 65-byte uncompressed point."""
+    if not _HAVE_OPENSSL:
+        raise RuntimeError("cryptography not installed")
     if _CACHE_ENABLED:
         return _pub_obj_cached(pubkey)
     return ec.EllipticCurvePublicKey.from_encoded_point(_CURVE, pubkey)
+
+
+# --- parsed-key tables (ISSUE 7) --------------------------------------------
+# The batch crypto engine consumes RAW forms: 64-byte X||Y points and
+# 32-byte scalars.  Validation (curve membership, scalar range) costs a
+# field computation per key; these tables pay it once per distinct key
+# instead of once per batch item, extending the EVP-object cache above
+# to the native tier.
+
+def _pub_point64_impl(pubkey: bytes) -> bytes:
+    if len(pubkey) != 65 or pubkey[0] != 4:
+        raise ValueError("not an uncompressed secp256k1 point")
+    point = pubkey[1:]
+    from .native import get_native
+    native = get_native()
+    if native.available:
+        if not native.point_check(point):
+            raise ValueError("point not on curve")
+    else:
+        from . import fallback
+        fallback.decode_point(pubkey)   # raises off-curve
+    return point
+
+
+_pub_point64_cached = functools.lru_cache(maxsize=4096)(_pub_point64_impl)
+
+
+def pub_point64(pubkey: bytes) -> bytes:
+    """65-byte uncompressed pubkey -> validated 64-byte X||Y.
+
+    Raises ValueError for anything not an on-curve uncompressed point
+    (the same rejection the OpenSSL parser applies).  Honors the
+    ``set_key_cache`` switch like ``_priv_obj``/``pub_obj`` — the
+    bench baseline must not get cache wins the pre-PR code lacked.
+    """
+    if _CACHE_ENABLED:
+        return _pub_point64_cached(pubkey)
+    return _pub_point64_impl(pubkey)
+
+
+def _priv_scalar32_impl(privkey: bytes) -> bytes:
+    if len(privkey) != 32:
+        raise ValueError("private key must be 32 bytes")
+    k = int.from_bytes(privkey, "big")
+    if not 0 < k < _ORDER:
+        raise ValueError("private scalar out of range")
+    return privkey
+
+
+_priv_scalar32_cached = functools.lru_cache(maxsize=4096)(
+    _priv_scalar32_impl)
+
+
+def priv_scalar32(privkey: bytes) -> bytes:
+    """Validated 32-byte private scalar in [1, N-1] (cache-switched
+    like ``pub_point64``)."""
+    if _CACHE_ENABLED:
+        return _priv_scalar32_cached(privkey)
+    return _priv_scalar32_impl(privkey)
 
 
 def random_private_key() -> bytes:
@@ -116,8 +200,18 @@ def grind_random_keys(leading_zeros: int = 1):
 def priv_to_pub(privkey: bytes) -> bytes:
     """EC point multiplication: 32-byte scalar -> 65-byte uncompressed
     pubkey 0x04 || X || Y (reference: highlevelcrypto.pointMult)."""
-    return _priv_obj(privkey).public_key().public_bytes(
-        Encoding.X962, PublicFormat.UncompressedPoint)
+    if _HAVE_OPENSSL:
+        return _priv_obj(privkey).public_key().public_bytes(
+            Encoding.X962, PublicFormat.UncompressedPoint)
+    from .native import get_native
+    native = get_native()
+    if native.available:
+        out = native.base_mult(priv_scalar32(privkey))
+        if out is None:
+            raise ValueError("private scalar out of range")
+        return b"\x04" + out
+    from . import fallback
+    return fallback.priv_to_pub(privkey)
 
 
 # --- 0x02CA curve-tagged wire format ---------------------------------------

@@ -1,0 +1,197 @@
+"""ctypes binding for the native batch secp256k1 engine
+(native/secp256k1/bmsecp256k1.cpp).
+
+Mirrors ``pow/native.py``'s load flow: auto-``make`` when the shared
+object is missing or stale, refuse a library that fails its known-
+answer self-test, degrade to unavailable (never raise at import) on
+minimal images without a toolchain.
+
+The exported entry points are BATCH-shaped: one ctypes call per
+coalesced drain, the GIL released for the whole batch (ctypes drops it
+around foreign calls), ``std::thread`` fan-out across items inside the
+library.  Scalar bookkeeping (DER parsing, digest truncation,
+u1 = e/s, u2 = r/s mod n) stays in Python where big-int arithmetic is
+free — see ``crypto/batch.py`` for the preparation layer.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import subprocess
+import threading
+from pathlib import Path
+
+logger = logging.getLogger("pybitmessage_tpu.crypto")
+
+_NATIVE_DIR = (Path(__file__).resolve().parent.parent.parent
+               / "native" / "secp256k1")
+_LIB = _NATIVE_DIR / "libbmsecp256k1.so"
+_SRC = _NATIVE_DIR / "bmsecp256k1.cpp"
+
+#: process-wide disable switch (the ``set_key_cache(False)`` analog):
+#: the bench's honest pre-engine baseline and the forced-fallback
+#: parity tests run the exact ladder a build without the native
+#: library runs
+_FORCE_DISABLED = False
+
+
+def set_native_enabled(enabled: bool) -> None:
+    globals()["_FORCE_DISABLED"] = not enabled
+
+
+def native_enabled() -> bool:
+    return not _FORCE_DISABLED
+
+
+class NativeSecp:
+    """Batch secp256k1 + AES-256-CBC backend.
+
+    ``num_threads=0`` lets the library fan each batch across all
+    hardware threads; the context-reuse (the fixed-base comb table for
+    G) is built once inside the library on first use.
+    """
+
+    def __init__(self, num_threads: int = 0):
+        self.num_threads = num_threads
+        self._lib = self._load()
+
+    @staticmethod
+    def _build() -> bool:
+        try:
+            subprocess.run(["make"], cwd=_NATIVE_DIR, check=True,
+                           capture_output=True, timeout=120)
+            return True
+        except Exception as exc:
+            logger.warning("could not build native secp256k1: %r", exc)
+            return False
+
+    def _load(self):
+        if not _SRC.exists():
+            logger.warning("native secp256k1 source missing; disabled")
+            return None
+        stale = (_LIB.exists()
+                 and _LIB.stat().st_mtime < _SRC.stat().st_mtime)
+        if (not _LIB.exists() or stale) and not self._build():
+            # never load a stale library: an ABI-mismatched .so could
+            # pass a lenient check yet corrupt batch results
+            logger.error("native secp256k1 unbuildable%s; disabled",
+                         " and stale" if stale else "")
+            return None
+        try:
+            lib = ctypes.CDLL(str(_LIB))
+            u8p = ctypes.c_char_p
+            lib.tpu_secp_verify_batch.restype = None
+            lib.tpu_secp_verify_batch.argtypes = [
+                ctypes.c_int, u8p, u8p, u8p, u8p, ctypes.c_int, u8p]
+            lib.tpu_secp_ecdh_batch.restype = None
+            lib.tpu_secp_ecdh_batch.argtypes = [
+                ctypes.c_int, u8p, u8p, ctypes.c_int, u8p, u8p]
+            lib.tpu_secp_base_mult.restype = ctypes.c_int
+            lib.tpu_secp_base_mult.argtypes = [u8p, u8p]
+            lib.tpu_secp_point_check.restype = ctypes.c_int
+            lib.tpu_secp_point_check.argtypes = [u8p]
+            lib.tpu_secp_aes256cbc.restype = ctypes.c_int
+            lib.tpu_secp_aes256cbc.argtypes = [
+                ctypes.c_int, u8p, u8p, u8p, ctypes.c_int, u8p]
+            lib.tpu_secp_selftest.restype = ctypes.c_int
+            lib.tpu_secp_selftest.argtypes = []
+            if not lib.tpu_secp_selftest():
+                logger.error(
+                    "native secp256k1 failed self-test; disabled")
+                return None
+            return lib
+        except OSError as exc:
+            logger.warning("could not load native secp256k1: %r", exc)
+            return None
+
+    @property
+    def available(self) -> bool:
+        return self._lib is not None and not _FORCE_DISABLED
+
+    def _require(self):
+        if self._lib is None:
+            raise RuntimeError("native secp256k1 unavailable")
+        return self._lib
+
+    # -- batch entry points --------------------------------------------------
+
+    def verify_prepared(self, n: int, u1s: bytes, u2s: bytes,
+                        pubs: bytes, rs: bytes,
+                        nthreads: int | None = None) -> list[bool]:
+        """Batch ECDSA acceptance over pre-reduced scalars.
+
+        Buffers are packed item-major: ``u1s``/``u2s``/``rs`` hold n
+        32-byte big-endian scalars, ``pubs`` n 64-byte X||Y points.
+        Returns per-item booleans; an unloadable point or zero u2 is
+        simply False (matching the pure tiers' never-raise contract).
+        """
+        lib = self._require()
+        if not (len(u1s) == len(u2s) == len(rs) == 32 * n
+                and len(pubs) == 64 * n):
+            raise ValueError("bad verify batch packing")
+        ok = ctypes.create_string_buffer(n)
+        lib.tpu_secp_verify_batch(
+            n, u1s, u2s, pubs, rs,
+            self.num_threads if nthreads is None else nthreads, ok)
+        return [b == 1 for b in ok.raw]
+
+    def ecdh_batch(self, n: int, points: bytes, scalars: bytes,
+                   nthreads: int | None = None) -> list[bytes | None]:
+        """Batch ECDH: per item, scalar_i * point_i -> 32-byte raw X
+        (the exact ECDH_compute_key bytes the ECIES KDF hashes), or
+        None for an invalid point/scalar.  The hot ECIES shape repeats
+        ONE object's ephemeral point across all candidate scalars.
+        """
+        lib = self._require()
+        if not (len(points) == 64 * n and len(scalars) == 32 * n):
+            raise ValueError("bad ecdh batch packing")
+        xout = ctypes.create_string_buffer(32 * n)
+        ok = ctypes.create_string_buffer(n)
+        lib.tpu_secp_ecdh_batch(
+            n, points, scalars,
+            self.num_threads if nthreads is None else nthreads, xout, ok)
+        raw = xout.raw
+        return [raw[32 * i:32 * i + 32] if ok.raw[i] == 1 else None
+                for i in range(n)]
+
+    def base_mult(self, scalar: bytes) -> bytes | None:
+        """scalar * G -> 64-byte X||Y, or None for an out-of-range
+        scalar (comb-table fixed-base path)."""
+        lib = self._require()
+        out = ctypes.create_string_buffer(64)
+        if not lib.tpu_secp_base_mult(scalar, out):
+            return None
+        return out.raw
+
+    def point_check(self, point64: bytes) -> bool:
+        """Curve-membership test for the parsed-key tables."""
+        lib = self._require()
+        return bool(lib.tpu_secp_point_check(point64))
+
+    def aes256_cbc(self, encrypt: bool, key: bytes, iv: bytes,
+                   data: bytes) -> bytes:
+        """AES-256-CBC over ``len(data) % 16 == 0`` bytes (PKCS7 stays
+        in Python for parity across tiers)."""
+        lib = self._require()
+        if len(key) != 32 or len(iv) != 16 or len(data) % 16:
+            raise ValueError("bad AES-256-CBC parameters")
+        out = ctypes.create_string_buffer(len(data) or 1)
+        if not lib.tpu_secp_aes256cbc(1 if encrypt else 0, key, iv,
+                                      data, len(data), out):
+            raise RuntimeError("native AES-256-CBC failed")
+        return out.raw[:len(data)]
+
+
+_ENGINE: NativeSecp | None = None
+_ENGINE_LOCK = threading.Lock()
+
+
+def get_native() -> NativeSecp:
+    """Process-wide engine (the comb table costs ~1 ms to build and the
+    load/self-test flow should run once)."""
+    global _ENGINE
+    with _ENGINE_LOCK:
+        if _ENGINE is None:
+            _ENGINE = NativeSecp()
+        return _ENGINE

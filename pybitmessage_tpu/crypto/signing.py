@@ -3,39 +3,161 @@
 Reference behavior (src/highlevelcrypto.py:70-108): sign with the
 configured digest (sha256 default, sha1 legacy); verify accepts either
 digest so old-network signatures keep validating.
+
+Digest-hint table (ISSUE 7 satellite): the reference's accept-either
+rule means every legacy-SHA1 signature first pays a doomed SHA256
+attempt — a full double scalar multiplication thrown away per object
+from that peer.  ``digest_order`` remembers which digest a pubkey last
+verified under and tries it first; fallbacks (an attempt order whose
+first digest missed but a later one hit) are counted in
+``crypto_digest_fallback_total``.
+
+Execution ladder per attempt: OpenSSL-backed ``cryptography`` when
+installed, else the native batch engine (single-item batch), else the
+pure-Python tier — all three agree bit-for-bit (property-tested in
+tests/test_crypto_batch.py).
 """
 
 from __future__ import annotations
 
-from cryptography.hazmat.primitives import hashes
-from cryptography.hazmat.primitives.asymmetric import ec
+import hashlib
+import threading
+from collections import OrderedDict
 
-from .keys import _priv_obj, pub_obj
+from ..observability import REGISTRY
+from .keys import have_openssl, priv_scalar32, pub_point64
 
-_DIGESTS = {"sha256": hashes.SHA256, "sha1": hashes.SHA1}
+if have_openssl():
+    from cryptography.hazmat.primitives import hashes
+    from cryptography.hazmat.primitives.asymmetric import ec
+
+    from .keys import _priv_obj, pub_obj
+
+_DIGESTS = ("sha256", "sha1")
+
+DIGEST_FALLBACKS = REGISTRY.counter(
+    "crypto_digest_fallback_total",
+    "Signature verifications that missed on the hinted/default digest "
+    "and succeeded on a later one (legacy-peer detection)")
+
+#: pubkey -> digest name that last verified; bounded LRU so a pubkey
+#: flood cannot grow it unbounded
+_HINT_CAP = 4096
+_HINTS: OrderedDict[bytes, str] = OrderedDict()
+_HINTS_LOCK = threading.Lock()
+
+
+def digest_order(pubkey: bytes) -> tuple[str, ...]:
+    """Digest attempt order for ``pubkey``: the remembered hit first,
+    the network default (sha256) order otherwise."""
+    with _HINTS_LOCK:
+        hint = _HINTS.get(pubkey)
+        if hint is not None:
+            _HINTS.move_to_end(pubkey)
+    if hint is None or hint == _DIGESTS[0]:
+        return _DIGESTS
+    return (hint,) + tuple(d for d in _DIGESTS if d != hint)
+
+
+def note_digest(pubkey: bytes, digest: str, *, fallback: bool) -> None:
+    """Record which digest verified for ``pubkey``; ``fallback`` marks
+    an attempt order whose first choice missed (counted).  First-choice
+    hits (``fallback=False``) only refresh LRU position when the hint
+    changes — the common warm-hint case skips the write."""
+    if fallback:
+        DIGEST_FALLBACKS.inc()
+    elif digest == _DIGESTS[0]:
+        # default-digest hit with no stored hint needed: the default
+        # order already tries it first
+        with _HINTS_LOCK:
+            if _HINTS.get(pubkey) in (None, digest):
+                return
+    with _HINTS_LOCK:
+        _HINTS[pubkey] = digest
+        _HINTS.move_to_end(pubkey)
+        while len(_HINTS) > _HINT_CAP:
+            _HINTS.popitem(last=False)
+
+
+#: constructor table — ``hashlib.new(name)`` costs ~10x a direct
+#: constructor call, which matters at batch-prep rates
+_HASHERS = {"sha256": hashlib.sha256, "sha1": hashlib.sha1}
+
+
+def _hash(data: bytes, digest: str) -> bytes:
+    return _HASHERS[digest](data).digest()
 
 
 def sign(data: bytes, privkey: bytes, digest: str = "sha256") -> bytes:
     """DER-encoded ECDSA signature of ``data``."""
-    algo = _DIGESTS[digest]()
-    return _priv_obj(privkey).sign(data, ec.ECDSA(algo))
+    if digest not in _DIGESTS:
+        raise KeyError(digest)
+    if have_openssl():
+        algo = (hashes.SHA256 if digest == "sha256" else hashes.SHA1)()
+        return _priv_obj(privkey).sign(data, ec.ECDSA(algo))
+    # native tier has no signer (receive side is the hot path); the
+    # deterministic-nonce pure tier interoperates with any verifier
+    from . import fallback
+    return fallback.ecdsa_sign_digest(_hash(data, digest),
+                                      priv_scalar32(privkey))
 
 
-def verify(data: bytes, signature: bytes, pubkey: bytes) -> bool:
+def _verify_one(data: bytes, signature: bytes, pubkey: bytes,
+                digest: str, *, allow_native: bool = True) -> bool:
+    """One (digest, signature) attempt through the backend ladder;
+    False (never an exception) on any malformation."""
+    if have_openssl():
+        try:
+            key = pub_obj(pubkey)
+            algo = (hashes.SHA256 if digest == "sha256"
+                    else hashes.SHA1)()
+            key.verify(signature, data, ec.ECDSA(algo))
+            return True
+        except Exception:
+            return False
+    from . import fallback
+    try:
+        if allow_native:
+            point = pub_point64(pubkey)
+            pub = (int.from_bytes(point[:32], "big"),
+                   int.from_bytes(point[32:], "big"))
+        else:
+            # the no-native rung validates the point itself too —
+            # pub_point64's curve check routes through the native
+            # library when it is loaded
+            pub = fallback.decode_point(pubkey)
+        r, s = fallback.der_decode_sig(signature)
+        e = fallback.digest_to_scalar(_hash(data, digest))
+    except ValueError:
+        return False
+    if allow_native:
+        from .native import get_native
+        native = get_native()
+        if native.available:
+            if not (0 < r < fallback.N and 0 < s < fallback.N):
+                return False
+            w = pow(s, -1, fallback.N)
+            u1 = ((e * w) % fallback.N).to_bytes(32, "big")
+            u2 = ((r * w) % fallback.N).to_bytes(32, "big")
+            return native.verify_prepared(1, u1, u2, point,
+                                          r.to_bytes(32, "big"))[0]
+    return fallback.ecdsa_verify_scalars(e, r, s, pub)
+
+
+def verify(data: bytes, signature: bytes, pubkey: bytes, *,
+           allow_native: bool = True) -> bool:
     """True if ``signature`` verifies under SHA1 *or* SHA256.
 
     Never raises: malformed signatures/keys simply fail verification
     (the reference wraps both attempts in bare excepts,
-    highlevelcrypto.py:90-108).
+    highlevelcrypto.py:90-108).  Attempt order follows the per-pubkey
+    digest hint so legacy-SHA1 peers stop paying a doomed SHA256 pass.
+    ``allow_native=False`` skips the native rung of the per-attempt
+    ladder (the batch engine's fallback tier after a native failure).
     """
-    try:
-        key = pub_obj(pubkey)
-    except Exception:
-        return False
-    for algo in (hashes.SHA256(), hashes.SHA1()):
-        try:
-            key.verify(signature, data, ec.ECDSA(algo))
+    for i, digest in enumerate(digest_order(pubkey)):
+        if _verify_one(data, signature, pubkey, digest,
+                       allow_native=allow_native):
+            note_digest(pubkey, digest, fallback=i > 0)
             return True
-        except Exception:
-            continue
     return False

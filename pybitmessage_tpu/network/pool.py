@@ -130,8 +130,17 @@ class NodeContext:
         self.proxy: dict | None = None
 
     def enable_tls(self, directory=None) -> None:
-        from .tls import generate_self_signed_cert
-        self.tls_files = generate_self_signed_cert(directory)
+        # graceful degradation on minimal images: the ephemeral cert
+        # needs the optional `cryptography` package; without it the
+        # node simply doesn't advertise NODE_SSL (TLS is opportunistic
+        # and negotiated, so plaintext peering still interoperates)
+        try:
+            from .tls import generate_self_signed_cert
+            self.tls_files = generate_self_signed_cert(directory)
+        except ImportError as exc:
+            logger.warning(
+                "TLS disabled: `cryptography` not installed (%s)", exc)
+            return
         self.services |= 2  # NODE_SSL
 
 

@@ -16,6 +16,14 @@ Batch APIs:
   still queued when a key matches never run (a match sets a shared
   event every queued attempt checks before doing work).
 
+When a :class:`~pybitmessage_tpu.crypto.batch.BatchCryptoEngine` is
+attached (``self.batch``) and running, ``verify``/``verify_many`` and
+``try_decrypt_many`` route through it instead: checks coalesce across
+objects and connections into GIL-releasing native batch calls
+(docs/ingest.md, "Batched native crypto").  The per-call pool path
+below remains the fallback (engine absent, stopped, or bench
+baseline).
+
 Parsed key objects are cached in ``crypto.keys`` (lru), so the
 per-object scalar multiplication of re-deriving the same identity keys
 disappears from the hot loop.
@@ -72,13 +80,18 @@ class CryptoPool:
     """
 
     def __init__(self, size: int | None = None, *,
-                 decrypt_fn=None, verify_fn=None):
+                 decrypt_fn=None, verify_fn=None, batch=None):
         #: 0 = inline synchronous execution (the pre-pool path)
         self.size = DEFAULT_POOL_SIZE if size is None else size
         self._exec: ThreadPoolExecutor | None = None
         self._lock = threading.Lock()
         self._decrypt = decrypt_fn
         self._verify = verify_fn
+        #: optional coalescing batch engine (crypto/batch.py); its
+        #: drain task is started/stopped by whoever owns the pool
+        #: (ObjectProcessor) — when not running, the per-call paths
+        #: below serve
+        self.batch = batch
 
     def _decrypt_fn(self):
         if self._decrypt is None:
@@ -91,6 +104,9 @@ class CryptoPool:
             from ..crypto import verify
             self._verify = verify
         return self._verify
+
+    def _batch_active(self) -> bool:
+        return self.batch is not None and self.batch.running
 
     def _executor(self) -> ThreadPoolExecutor:
         with self._lock:
@@ -121,6 +137,8 @@ class CryptoPool:
                      pubkey: bytes) -> bool:
         """One ECDSA verification off the loop (never raises)."""
         OPS.labels(op="verify").inc()
+        if self._batch_active():
+            return await self.batch.verify(data, signature, pubkey)
         return bool(await self.run(self._verify_fn(), data, signature,
                                    pubkey))
 
@@ -130,8 +148,11 @@ class CryptoPool:
         """Fan ``(data, signature, pubkey)`` checks across the pool."""
         if not items:
             return []
-        _verify = self._verify_fn()
         OPS.labels(op="verify").inc(len(items))
+        if self._batch_active():
+            return list(await asyncio.gather(
+                *[self.batch.verify(*item) for item in items]))
+        _verify = self._verify_fn()
         if self.size == 0:
             return [bool(_verify(*item)) for item in items]
         loop = asyncio.get_running_loop()
@@ -156,14 +177,22 @@ class CryptoPool:
         ECDH+HMAC.  An object is encrypted to exactly one key, so under
         a wide identity set most attempts are skipped once the right
         key lands.
-        """
-        _decrypt = self._decrypt_fn()
 
+        With a running batch engine the whole sweep coalesces with
+        other objects' sweeps instead (wavefront early-exit inside the
+        engine replaces the event-based cancel).
+        """
         keys = list(keys)
         DECRYPT_FANOUT.observe(len(keys))
         OPS.labels(op="decrypt").inc(len(keys))
         if not keys:
             return []
+        if self._batch_active():
+            matches = await self.batch.try_decrypt(payload, keys)
+            DECRYPT_RESULTS.labels(
+                result="hit" if matches else "miss").inc()
+            return matches
+        _decrypt = self._decrypt_fn()
 
         found = threading.Event()
         skipped = [0]

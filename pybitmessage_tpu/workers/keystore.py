@@ -10,6 +10,7 @@ object; keys persist in an INI file (keys.dat equivalent).
 from __future__ import annotations
 
 import configparser
+import logging
 import os
 import time
 from dataclasses import dataclass, field
@@ -25,6 +26,8 @@ from ..models.constants import (
 from ..models.payloads import broadcast_v4_key, double_hash_of_address_data
 from ..utils.addresses import decode_address, encode_address
 from ..utils.hashes import address_ripe
+
+logger = logging.getLogger("pybitmessage_tpu.keystore")
 
 
 @dataclass
@@ -217,6 +220,9 @@ class KeyStore:
                                 label, full, True, a.version, a.stream,
                                 a.ripe)
                         except Exception:
+                            logger.warning(
+                                "skipping undecodable subscription "
+                                "address %r in keys.dat", addr)
                             continue
                 continue
             s = cfg[section]

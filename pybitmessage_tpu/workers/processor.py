@@ -106,12 +106,19 @@ class ObjectProcessor:
                  ui_signal=None, crypto: CryptoPool | None = None,
                  concurrency: int = DEFAULT_CONCURRENCY,
                  write_behind: bool = True,
+                 crypto_batch: bool = True,
                  flush_interval: float = DEFAULT_FLUSH_INTERVAL):
         #: UISignaler.emit-compatible callback (may be None)
         self.ui_signal = ui_signal or (lambda cmd, data=(): None)
         self.keystore = keystore
         #: crypto worker pool — the decrypt/sig-verify stages run here
         self.crypto = crypto or CryptoPool()
+        #: coalescing batch crypto engine (docs/ingest.md): decrypt and
+        #: sig_verify checks from all workers coalesce into native
+        #: batch drains; its task lives with the pipeline workers
+        if crypto_batch and self.crypto.batch is None:
+            from ..crypto.batch import BatchCryptoEngine
+            self.crypto.batch = BatchCryptoEngine()
         #: write-behind: ingest-path rows coalesce into one
         #: transaction per drain (storage/writebehind.py)
         self._wb = None
@@ -165,6 +172,8 @@ class ObjectProcessor:
         if restored:
             logger.info("restored %d unprocessed objects", len(restored))
         self._running = True
+        if self.crypto.batch is not None and not self.crypto.batch.running:
+            self.crypto.batch.start()
         self._tasks = [asyncio.create_task(self._run())
                        for _ in range(self.concurrency)]
         if self._wb is not None:
@@ -207,6 +216,8 @@ class ObjectProcessor:
         if self._wb is not None and self._wb.pending_rows():
             if not self._wb.flush():
                 self._wb.flush()     # one more drain after the backoff
+        if self.crypto.batch is not None:
+            await self.crypto.batch.stop()
         self.crypto.close()
 
     def pending(self) -> int:

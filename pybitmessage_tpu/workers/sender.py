@@ -144,6 +144,8 @@ class SendWorker:
             try:
                 a = decode_address(m.toaddress)
             except Exception:
+                logger.warning("sent row awaiting pubkey has "
+                               "undecodable address %r", m.toaddress)
                 continue
             tag = double_hash_of_address_data(a.version, a.stream, a.ripe)[32:]
             self.needed_pubkeys[tag] = m.toaddress
@@ -549,6 +551,8 @@ class SendWorker:
                 try:
                     to = decode_address(m.toaddress)
                 except Exception:
+                    logger.warning("resend row has undecodable "
+                                   "address %r", m.toaddress)
                     continue
                 tag = double_hash_of_address_data(
                     to.version, to.stream, to.ripe)[32:]

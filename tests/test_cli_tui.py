@@ -100,6 +100,7 @@ async def test_cli_contacts_chans_and_errors():
         await asyncio.to_thread(run_command, rpc, "frobnicate", [])
 
 
+@pytest.mark.slow       # live-node send+ack round trip (PoW-bound)
 @pytest.mark.asyncio
 async def test_tui_view_model_renders_all_panes():
   async with live_api() as (node, rpc):

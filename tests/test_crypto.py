@@ -83,6 +83,77 @@ def test_ecies_tamper_detected():
         decrypt(bytes(ct), priv)
 
 
+# --- ECIES edge cases (ISSUE 7 satellite): every malformation raises
+# --- DecryptionError and NOTHING ELSE — a different exception type
+# --- would let callers (or timing observers) distinguish failure modes
+
+
+def _assert_only_decryption_error(payload: bytes, priv: bytes):
+    try:
+        decrypt(payload, priv)
+    except DecryptionError:
+        return
+    except BaseException as exc:  # pragma: no cover - the failure case
+        pytest.fail("raised %r instead of DecryptionError" % (exc,))
+    pytest.fail("malformed payload decrypted")
+
+
+def test_ecies_truncated_payload():
+    priv = random_private_key()
+    good = encrypt(b"edge case payload", priv_to_pub(priv))
+    # every truncation point: below the minimum, mid-pubkey, mid-MAC
+    for cut in (0, 1, 15, 16, 20, len(good) // 2,
+                len(good) - 33, len(good) - 1):
+        _assert_only_decryption_error(good[:cut], priv)
+
+
+def test_ecies_flipped_mac_byte():
+    priv = random_private_key()
+    good = encrypt(b"mac flip", priv_to_pub(priv))
+    for i in range(1, 33):      # every byte of the 32-byte tag
+        bad = bytearray(good)
+        bad[-i] ^= 0x01
+        _assert_only_decryption_error(bytes(bad), priv)
+
+
+def test_ecies_wrong_curve_tag():
+    priv = random_private_key()
+    good = bytearray(encrypt(b"curve tag", priv_to_pub(priv)))
+    # the 0x02CA tag sits right after the 16-byte IV
+    good[16] = 0x03
+    _assert_only_decryption_error(bytes(good), priv)
+
+
+def test_ecies_zero_length_ciphertext():
+    priv = random_private_key()
+    pub = priv_to_pub(priv)
+    good = encrypt(b"x" * 16, pub)
+    from pybitmessage_tpu.crypto.ecies import parse_payload
+    parsed = parse_payload(good)
+    # rebuild the payload with the ciphertext removed entirely
+    head = good[:len(good) - 32 - len(parsed.ciphertext)]
+    _assert_only_decryption_error(head + good[len(good) - 32:], priv)
+
+
+def test_ecies_zero_length_plaintext_roundtrip():
+    # a zero-length PLAINTEXT is legal (one PKCS7 padding block)
+    priv = random_private_key()
+    assert decrypt(encrypt(b"", priv_to_pub(priv)), priv) == b""
+
+
+def test_ecies_mac_compared_constant_time():
+    """The MAC acceptance path must route through
+    ``hmac.compare_digest`` — a bytewise == would leak a timing oracle
+    over the tag prefix."""
+    import inspect
+
+    from pybitmessage_tpu.crypto import ecies
+    src = inspect.getsource(ecies.mac_ok)
+    assert "compare_digest" in src
+    # and decrypt() must reject via that same helper
+    assert "mac_ok" in inspect.getsource(ecies.decrypt)
+
+
 def test_pubkey_wire_round_trip():
     pub = priv_to_pub(random_private_key())
     wire = encode_pubkey_wire(pub)

@@ -1,0 +1,473 @@
+"""Batch crypto engine tests (ISSUE 7).
+
+Covers the native/pure parity property (bit-identical results across
+randomized vectors for ECDSA verify and ECDH), the coalescing
+dispatcher mechanics, the breaker-supervised native->pure fallback
+ladder (including the ``crypto.native`` chaos site with zero check
+loss), the per-pubkey digest-hint table, and the parsed-key tables.
+
+The native-library tests skip themselves when the shared object is
+unbuilt (minimal images without a toolchain); the pure tiers and the
+engine's fallback path are exercised everywhere.
+"""
+
+import asyncio
+import os
+import secrets
+
+import pytest
+
+from pybitmessage_tpu.crypto import (
+    encrypt, priv_to_pub, random_private_key, sign, verify,
+)
+from pybitmessage_tpu.crypto import fallback, signing
+from pybitmessage_tpu.crypto.batch import BatchCryptoEngine
+from pybitmessage_tpu.crypto.keys import (
+    priv_scalar32, pub_point64, set_key_cache,
+)
+from pybitmessage_tpu.crypto.native import get_native, set_native_enabled
+from pybitmessage_tpu.observability import REGISTRY
+from pybitmessage_tpu.resilience import CHAOS
+
+NATIVE = get_native()
+needs_native = pytest.mark.skipif(
+    not NATIVE.available, reason="native secp256k1 library unbuilt")
+
+
+def _sample(name, labels=None):
+    return REGISTRY.sample(name, labels) or 0.0
+
+
+# ---------------------------------------------------------------------------
+# native self-test + primitives
+# ---------------------------------------------------------------------------
+
+@needs_native
+def test_native_selftest_and_base_mult_golden():
+    from binascii import unhexlify
+    sk = unhexlify("93d0b61371a54b53df143b954035d612"
+                   "f8efa8a3ed1cf842c2186bfd8f876665")
+    pk = priv_to_pub(sk)
+    out = NATIVE.base_mult(sk)
+    assert out is not None and b"\x04" + out == pk
+    # out-of-range scalars refused
+    assert NATIVE.base_mult(b"\x00" * 32) is None
+    assert NATIVE.base_mult(b"\xff" * 32) is None
+
+
+@needs_native
+def test_native_point_check():
+    pub = priv_to_pub(random_private_key())
+    assert NATIVE.point_check(pub[1:])
+    bad = bytearray(pub[1:])
+    bad[-1] ^= 1
+    assert not NATIVE.point_check(bytes(bad))
+
+
+@needs_native
+def test_native_aes_parity_with_python():
+    for size in (16, 64, 1024):
+        key, iv = os.urandom(32), os.urandom(16)
+        data = os.urandom(size)
+        ct_native = NATIVE.aes256_cbc(True, key, iv, data)
+        assert ct_native == fallback.aes256_cbc(True, key, iv, data)
+        assert NATIVE.aes256_cbc(False, key, iv, ct_native) == data
+        assert fallback.aes256_cbc(False, key, iv, ct_native) == data
+
+
+# ---------------------------------------------------------------------------
+# parity property: native batch bit-identical to the pure path
+# (ISSUE 7 satellite: 1k randomized vectors, skip-if-unbuilt)
+# ---------------------------------------------------------------------------
+
+def _random_verify_vectors(n, privs, pubs):
+    """Mixed valid/corrupt signature checks, deterministic per seed."""
+    vectors = []
+    for i in range(n):
+        k = i % len(privs)
+        data = b"parity vector %d" % i
+        digest = "sha1" if i % 3 == 0 else "sha256"
+        sig = sign(data, privs[k], digest)
+        kind = i % 7
+        if kind == 0:
+            sig = bytearray(sig)
+            sig[-1] ^= 1                    # corrupt signature
+            sig = bytes(sig)
+        elif kind == 1:
+            data = data + b"!"              # wrong message
+        elif kind == 2:
+            sig = secrets.token_bytes(len(sig))   # garbage DER
+        vectors.append((data, sig, pubs[k]))
+    return vectors
+
+
+@needs_native
+def test_verify_parity_1k_vectors():
+    privs = [random_private_key() for _ in range(4)]
+    pubs = [priv_to_pub(p) for p in privs]
+    vectors = _random_verify_vectors(1000, privs, pubs)
+
+    async def engine_results():
+        eng = BatchCryptoEngine()
+        eng.start()
+        try:
+            return await asyncio.gather(
+                *[eng.verify(*v) for v in vectors])
+        finally:
+            await eng.stop()
+
+    got = asyncio.run(engine_results())
+    # pure-path oracle: the exact per-call ladder with native disabled
+    set_native_enabled(False)
+    try:
+        want = [verify(*v) for v in vectors]
+    finally:
+        set_native_enabled(True)
+    assert got == want
+    assert sum(want) > 0 and not all(want)   # the mix exercised both
+
+
+@needs_native
+def test_ecdh_parity_1k_vectors():
+    # one ephemeral point fanned across many scalars — the hot ECIES
+    # shape — plus fresh points, vs the pure-python oracle
+    point_priv = random_private_key()
+    peer = priv_to_pub(point_priv)
+    scalars, points = [], []
+    for i in range(1000):
+        scalars.append(random_private_key())
+        if i % 4 == 0:
+            peer = priv_to_pub(random_private_key())
+        points.append(peer)
+    got = NATIVE.ecdh_batch(
+        1000, b"".join(p[1:] for p in points), b"".join(scalars))
+    for x, scalar, point in zip(got, scalars, points):
+        assert x == fallback.ecdh_x(scalar, point)
+
+
+@needs_native
+def test_ecdh_batch_rejects_bad_operands():
+    good_pub = priv_to_pub(random_private_key())
+    bad_point = bytearray(good_pub[1:])
+    bad_point[-1] ^= 1
+    out = NATIVE.ecdh_batch(
+        3,
+        good_pub[1:] + bytes(bad_point) + good_pub[1:],
+        random_private_key() + random_private_key() + b"\x00" * 32)
+    assert out[0] is not None
+    assert out[1] is None       # off-curve point
+    assert out[2] is None       # zero scalar
+
+
+def test_forced_fallback_parity():
+    """crypto.native chaos at 100%%: every drain re-runs on the pure
+    tier, results bit-identical, fallback counter incremented, zero
+    checks lost (acceptance criterion)."""
+    privs = [random_private_key() for _ in range(3)]
+    pubs = [priv_to_pub(p) for p in privs]
+    vectors = _random_verify_vectors(30, privs, pubs)
+    payloads = [encrypt(b"fallback %d" % i, pubs[i % 3])
+                for i in range(6)]
+    payloads.append(encrypt(b"foreign", priv_to_pub(random_private_key())))
+    candidates = [(p, i) for i, p in enumerate(privs)]
+
+    async def run_all():
+        eng = BatchCryptoEngine()
+        eng.start()
+        try:
+            return await asyncio.gather(
+                *[eng.verify(*v) for v in vectors],
+                *[eng.try_decrypt(pl, candidates) for pl in payloads])
+        finally:
+            await eng.stop()
+
+    clean = asyncio.run(run_all())
+    before = _sample("crypto_native_fallback_total")
+    CHAOS.seed(1234)
+    CHAOS.arm("crypto.native", probability=1.0)
+    try:
+        chaotic = asyncio.run(run_all())
+    finally:
+        CHAOS.disarm()
+    assert chaotic == clean                     # zero loss, bit-equal
+    assert chaotic[:30] == [verify(*v) for v in vectors]
+    hits = [m for m in chaotic[30:] if m]
+    assert len(hits) == 6                       # every real match found
+    if NATIVE.available:
+        assert _sample("crypto_native_fallback_total") > before
+
+
+@needs_native
+def test_pure_tier_never_reenters_native():
+    """The engine's fallback tier is the refuge from a native failure:
+    it must answer correctly WITHOUT touching the library (a library
+    returning wrong results would otherwise corrupt its own
+    fallback)."""
+    privs = [random_private_key() for _ in range(2)]
+    pubs = [priv_to_pub(p) for p in privs]
+    sig = sign(b"isolated", privs[0])
+    payload = encrypt(b"isolated body", pubs[1])
+    candidates = [(p, i) for i, p in enumerate(privs)]
+
+    def poisoned(*a, **k):
+        raise AssertionError("pure tier re-entered the native library")
+
+    async def main():
+        eng = BatchCryptoEngine(use_native=False)
+        eng.start()
+        try:
+            ok = await eng.verify(b"isolated", sig, pubs[0])
+            matches = await eng.try_decrypt(payload, candidates)
+        finally:
+            await eng.stop()
+        return ok, matches
+
+    orig = (NATIVE.verify_prepared, NATIVE.ecdh_batch,
+            NATIVE.aes256_cbc, NATIVE.point_check)
+    NATIVE.verify_prepared = NATIVE.ecdh_batch = poisoned
+    NATIVE.aes256_cbc = NATIVE.point_check = poisoned
+    try:
+        ok, matches = asyncio.run(main())
+    finally:
+        (NATIVE.verify_prepared, NATIVE.ecdh_batch,
+         NATIVE.aes256_cbc, NATIVE.point_check) = orig
+    assert ok is True
+    assert matches == [(b"isolated body", 1)]
+
+
+def test_breaker_opens_after_repeated_native_failures():
+    priv = random_private_key()
+    pub = priv_to_pub(priv)
+    sig = sign(b"breaker", priv)
+
+    async def main():
+        eng = BatchCryptoEngine()
+        assert eng.breaker.threshold == 3
+        eng.start()
+        try:
+            CHAOS.arm("crypto.native", probability=1.0)
+            try:
+                # three sequential drains = three native failures
+                for _ in range(3):
+                    assert await eng.verify(b"breaker", sig, pub) is True
+            finally:
+                CHAOS.disarm()
+            if NATIVE.available:
+                assert eng.breaker.state == "open"
+                # breaker open: the engine skips the native attempt
+                # entirely (no new fallback count) yet still answers
+                before = _sample("crypto_native_fallback_total")
+                assert await eng.verify(b"breaker", sig, pub) is True
+                assert _sample("crypto_native_fallback_total") == before
+        finally:
+            await eng.stop()
+
+    asyncio.run(main())
+
+
+# ---------------------------------------------------------------------------
+# coalescing mechanics
+# ---------------------------------------------------------------------------
+
+def test_drains_coalesce_across_callers():
+    priv = random_private_key()
+    pub = priv_to_pub(priv)
+    sig = sign(b"coalesce", priv)
+
+    async def main():
+        # a window large enough that all 16 checks land in ONE drain
+        eng = BatchCryptoEngine(window=0.05)
+        eng.start()
+        try:
+            oks = await asyncio.gather(
+                *[eng.verify(b"coalesce", sig, pub) for _ in range(16)])
+        finally:
+            await eng.stop()
+        return oks
+
+    child = REGISTRY.get("crypto_batch_size").labels(op="verify")
+    before = child.snapshot()[2]
+    assert all(asyncio.run(main()))
+    # 16 checks arrived in far fewer drains than 16 — and at least one
+    # drain carried several checks
+    drains = child.snapshot()[2] - before
+    assert 1 <= drains < 16
+
+
+def test_wavefront_stops_after_match():
+    """The decrypt sweep must not compute ECDH for candidates past the
+    first match (MAC-first wavefront early-exit)."""
+    if not NATIVE.available:
+        pytest.skip("needs the native wavefront path")
+    privs = [random_private_key() for _ in range(8)]
+    pubs = [priv_to_pub(p) for p in privs]
+    payload = encrypt(b"early exit", pubs[1])   # match at round 1
+    candidates = [(p, i) for i, p in enumerate(privs)]
+
+    calls = []
+    orig = NATIVE.ecdh_batch
+
+    def counting(n, points, scalars, nthreads=None):
+        calls.append(n)
+        return orig(n, points, scalars, nthreads=nthreads)
+
+    async def main():
+        eng = BatchCryptoEngine()
+        eng.start()
+        try:
+            return await eng.try_decrypt(payload, candidates)
+        finally:
+            await eng.stop()
+
+    NATIVE.ecdh_batch = counting
+    try:
+        matches = asyncio.run(main())
+    finally:
+        NATIVE.ecdh_batch = orig
+    assert matches == [(b"early exit", 1)]
+    assert sum(calls) == 2      # rounds 0 and 1 only, never rounds 2-7
+
+
+def test_empty_candidates_and_malformed_payload():
+    async def main():
+        eng = BatchCryptoEngine()
+        eng.start()
+        try:
+            assert await eng.try_decrypt(b"\x00" * 200, []) == []
+            assert await eng.try_decrypt(
+                b"garbage", [(random_private_key(), 0)]) == []
+            # an invalid candidate key is a miss, not an error
+            payload = encrypt(b"x", priv_to_pub(random_private_key()))
+            assert await eng.try_decrypt(
+                payload, [(b"\x00" * 32, 0)]) == []
+        finally:
+            await eng.stop()
+    asyncio.run(main())
+
+
+def test_shutdown_settles_pending_checks():
+    priv = random_private_key()
+    pub = priv_to_pub(priv)
+    sig = sign(b"settle", priv)
+
+    async def main():
+        eng = BatchCryptoEngine(window=5.0)   # drain would take 5 s
+        eng.start()
+        task = asyncio.create_task(eng.verify(b"settle", sig, pub))
+        await asyncio.sleep(0.05)             # job popped, in window
+        before = _sample("crypto_batch_shutdown_settled_total")
+        await eng.stop()
+        # settled deterministically False — never CancelledError
+        assert await task is False
+        assert _sample("crypto_batch_shutdown_settled_total") > before
+    asyncio.run(main())
+
+
+# ---------------------------------------------------------------------------
+# digest-hint table (ISSUE 7 satellite)
+# ---------------------------------------------------------------------------
+
+def test_digest_hint_skips_doomed_sha256():
+    priv = random_private_key()
+    pub = priv_to_pub(priv)
+    legacy = sign(b"legacy msg", priv, "sha1")
+    before = _sample("crypto_digest_fallback_total")
+    assert verify(b"legacy msg", legacy, pub)
+    assert _sample("crypto_digest_fallback_total") == before + 1
+    # hint remembered: sha1 now leads the order, no further fallback
+    assert signing.digest_order(pub)[0] == "sha1"
+    assert verify(b"legacy msg", legacy, pub)
+    assert _sample("crypto_digest_fallback_total") == before + 1
+    # a modern signature from the same key flips the hint back
+    assert verify(b"new msg", sign(b"new msg", priv), pub)
+    assert _sample("crypto_digest_fallback_total") == before + 2
+    assert signing.digest_order(pub)[0] == "sha256"
+
+
+def test_digest_hint_used_by_batch_engine():
+    priv = random_private_key()
+    pub = priv_to_pub(priv)
+    legacy = sign(b"batch legacy", priv, "sha1")
+
+    async def one():
+        eng = BatchCryptoEngine()
+        eng.start()
+        try:
+            return await eng.verify(b"batch legacy", legacy, pub)
+        finally:
+            await eng.stop()
+
+    before = _sample("crypto_digest_fallback_total")
+    assert asyncio.run(one())
+    assert _sample("crypto_digest_fallback_total") == before + 1
+    assert signing.digest_order(pub)[0] == "sha1"
+    # warm hint: the second check verifies first-try in round 1
+    assert asyncio.run(one())
+    assert _sample("crypto_digest_fallback_total") == before + 1
+
+
+# ---------------------------------------------------------------------------
+# parsed-key tables
+# ---------------------------------------------------------------------------
+
+def test_parsed_key_tables_validate_and_cache():
+    pub = priv_to_pub(random_private_key())
+    assert pub_point64(pub) == pub[1:]
+    with pytest.raises(ValueError):
+        pub_point64(b"\x04" + b"\x00" * 64)   # not on curve
+    with pytest.raises(ValueError):
+        pub_point64(b"\x02" + pub[1:33])      # compressed form
+    priv = random_private_key()
+    assert priv_scalar32(priv) == priv
+    with pytest.raises(ValueError):
+        priv_scalar32(b"\x00" * 32)
+    with pytest.raises(ValueError):
+        priv_scalar32(b"\xff" * 32)
+    # the cache switch clears the tables AND stops repopulation (the
+    # bench baseline must not get cache wins the pre-PR code lacked)
+    from pybitmessage_tpu.crypto.keys import _pub_point64_cached
+    set_key_cache(False)
+    try:
+        assert _pub_point64_cached.cache_info().currsize == 0
+        pub_point64(pub)
+        assert _pub_point64_cached.cache_info().currsize == 0
+    finally:
+        set_key_cache(True)
+
+
+# ---------------------------------------------------------------------------
+# DER codec (shared by the native prep and the pure-python tier)
+# ---------------------------------------------------------------------------
+
+def test_der_sig_round_trip_and_rejections():
+    for r, s in ((1, 1), (2 ** 255, 2 ** 200 + 7), (fallback.N - 1, 3)):
+        enc = fallback.der_encode_sig(r, s)
+        assert fallback.der_decode_sig(enc) == (r, s)
+    enc = fallback.der_encode_sig(12345, 67890)
+    for bad in (
+            b"", b"\x30\x00", enc[:-1], enc + b"\x00",
+            b"\x31" + enc[1:],                      # wrong envelope tag
+            enc[:2] + b"\x03" + enc[3:],            # wrong int tag
+    ):
+        with pytest.raises(ValueError):
+            fallback.der_decode_sig(bad)
+    # non-minimal integer encoding (leading zero) must be rejected
+    with pytest.raises(ValueError):
+        fallback.der_decode_sig(
+            b"\x30\x08\x02\x02\x00\x01\x02\x02\x00\x01")
+
+
+def test_pure_sign_verify_cross_tier():
+    """Signatures from the pure tier verify on every tier and vice
+    versa (the engine's fallback must accept native-era signatures)."""
+    priv = random_private_key()
+    pub = priv_to_pub(priv)
+    sig = fallback.ecdsa_sign_digest(
+        __import__("hashlib").sha256(b"cross").digest(), priv)
+    assert verify(b"cross", sig, pub)
+    set_native_enabled(False)
+    try:
+        assert verify(b"cross", sig, pub)
+        assert not verify(b"other", sig, pub)
+    finally:
+        set_native_enabled(True)

@@ -122,7 +122,8 @@ def test_gateway_config_roundtrips_through_keys_dat(tmp_path):
 
 # -- the two-node registration dance -----------------------------------------
 
-@pytest.mark.asyncio
+@pytest.mark.slow       # full registration dance: three 2-day-TTL
+@pytest.mark.asyncio    # command PoWs over live TCP (minutes)
 async def test_two_node_gateway_registration_denial_and_relay():
     """User node registers with a scripted gateway node; the gateway
     sees the request, denies it (flagged to the UI event stream), and

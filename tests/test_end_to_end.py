@@ -60,6 +60,7 @@ async def test_loopback_self_send():
         await node.stop()
 
 
+@pytest.mark.slow       # two live nodes, msg+ack+pubkey PoWs
 @pytest.mark.asyncio
 async def test_two_node_full_message_flow():
     """A knows only B's address.  getpubkey -> pubkey -> msg -> ack."""

@@ -62,6 +62,7 @@ async def test_nav_built_from_registry_and_key_navigation():
     assert not shell.handle_key("q")
 
 
+@pytest.mark.slow       # live-node shell journey (PoW-bound)
 @pytest.mark.asyncio
 async def test_every_registry_screen_opens_and_renders():
   async with live_shell() as (node, shell):
@@ -72,6 +73,7 @@ async def test_every_registry_screen_opens_and_renders():
         shell.back()
 
 
+@pytest.mark.slow       # live-node shell journey (PoW-bound)
 @pytest.mark.asyncio
 async def test_full_user_journey_through_the_shell():
   async with live_shell() as (node, shell):

@@ -790,7 +790,8 @@ def test_no_silent_exception_swallows():
             isinstance(stmt.value, ast.Constant)
 
     offenders = []
-    for pkg in ("pow", "network", "sync", "observability"):
+    for pkg in ("pow", "network", "sync", "observability", "crypto",
+                "workers"):
         for path in sorted((root / pkg).glob("*.py")):
             tree = ast.parse(path.read_text(), filename=str(path))
             for node in ast.walk(tree):
@@ -825,7 +826,10 @@ def test_metric_naming_conventions():
             "pybitmessage_tpu.utils.queues",
             "pybitmessage_tpu.workers.cryptopool",
             "pybitmessage_tpu.workers.sender",
-            "pybitmessage_tpu.workers.processor"):
+            "pybitmessage_tpu.workers.processor",
+            "pybitmessage_tpu.crypto.signing",
+            "pybitmessage_tpu.crypto.batch",
+            "pybitmessage_tpu.crypto.native"):
         try:
             importlib.import_module(mod)
         except ImportError:

@@ -354,3 +354,78 @@ def test_seeded_chaos_run_lands_in_flight_recorder_dump():
     # what fired in the run-up
     seqs = [e["seq"] for e in events]
     assert seqs == sorted(seqs)
+
+
+@pytest.mark.asyncio
+async def test_no_object_loss_under_crypto_native_faults():
+    """ISSUE 7 acceptance: with the ``crypto.native`` chaos site at
+    100%% fire rate, every msg object still decrypts, verifies and
+    delivers through the pure-tier fallback — zero objects lost — and
+    ``crypto_native_fallback_total`` increments."""
+    from pybitmessage_tpu.crypto import encrypt, sign
+    from pybitmessage_tpu.models import msgcoding
+    from pybitmessage_tpu.models.constants import OBJECT_MSG
+    from pybitmessage_tpu.models.payloads import (MsgPlaintext,
+                                                  get_bitfield,
+                                                  object_shell)
+    from pybitmessage_tpu.storage.db import Database
+    from pybitmessage_tpu.storage.messages import MessageStore
+    from pybitmessage_tpu.workers.keystore import KeyStore
+    from pybitmessage_tpu.workers.processor import ObjectProcessor
+
+    ks = KeyStore()
+    idents = [ks.create_random("chaos %d" % i) for i in range(3)]
+    for ident in idents:
+        ident.nonce_trials_per_byte = 1
+        ident.extra_bytes = 1
+    sender = idents[0]
+    ttl = 3600
+    expires = int(time.time()) + ttl
+    shell = object_shell(expires, OBJECT_MSG, 1, 1)
+
+    def build(i: int) -> bytes:
+        from pybitmessage_tpu.models.pow_math import pow_target
+        from pybitmessage_tpu.pow.dispatcher import python_solve
+        from pybitmessage_tpu.utils.hashes import sha512
+
+        r = idents[i % 3]
+        body = msgcoding.encode_message("chaos %d" % i, "body %d" % i)
+        plain = MsgPlaintext(
+            sender_version=sender.version, sender_stream=1,
+            bitfield=get_bitfield(False),
+            pub_signing_key=sender.pub_signing_key,
+            pub_encryption_key=sender.pub_encryption_key,
+            nonce_trials_per_byte=1, extra_bytes=1,
+            dest_ripe=r.ripe, encoding=2, message=body, ack_data=b"")
+        plain.signature = sign(shell + plain.encode_unsigned(),
+                               sender.priv_signing)
+        sans_nonce = shell + encrypt(plain.encode(), r.pub_encryption_key)
+        target = pow_target(len(sans_nonce) + 8, ttl, 1, 1, clamp=False)
+        nonce, _ = python_solve(sha512(sans_nonce), target)
+        return nonce.to_bytes(8, "big") + sans_nonce
+
+    payloads = [build(i) for i in range(9)]
+    db = Database()
+    store = MessageStore(db)
+    proc = ObjectProcessor(
+        keystore=ks, store=store, inventory=None,
+        sender=SimpleNamespace(watched_acks=set(), needed_pubkeys={},
+                               queue=asyncio.Queue()),
+        min_ntpb=1, min_extra=1, write_behind=False)
+    before = REGISTRY.sample("crypto_native_fallback_total") or 0
+    CHAOS.seed(SEED)
+    CHAOS.arm("crypto.native", probability=1.0)
+    try:
+        proc.start()
+        for p in payloads:
+            await proc.queue.put(p)
+        while proc.pending():
+            await asyncio.sleep(0.01)
+        await proc.stop()
+    finally:
+        CHAOS.disarm()
+    assert len(store.inbox()) == len(payloads), "objects lost"
+    from pybitmessage_tpu.crypto.native import get_native
+    if get_native().available:
+        assert REGISTRY.sample("crypto_native_fallback_total") > before
+    db.close()

@@ -78,6 +78,7 @@ def test_navigation_order_and_labels():
         i18n.install("en")
 
 
+@pytest.mark.slow       # live-node send+ack round trip (PoW-bound)
 @pytest.mark.asyncio
 async def test_screens_drive_live_node():
   async with live_vm() as (node, vm):

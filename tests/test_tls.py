@@ -5,6 +5,11 @@ import time
 
 import pytest
 
+# the ephemeral self-signed cert requires the optional `cryptography`
+# wheel; without it nodes degrade to plaintext peering (network/pool.py
+# enable_tls) and there is no TLS to test
+pytest.importorskip("cryptography")
+
 from pybitmessage_tpu.core import Node
 from pybitmessage_tpu.models.constants import NODE_SSL
 from pybitmessage_tpu.storage import Peer
