@@ -115,7 +115,9 @@ async def run(args) -> int:
                 udp_enabled=settings.getbool("udp") and not args.no_listen,
                 inventory_backend=settings.get("inventorystorage"),
                 pow_window=settings.getfloat("powbatchwindow"),
-                sync_enabled=settings.getbool("syncenabled"))
+                sync_enabled=settings.getbool("syncenabled"),
+                wiretrace_enabled=settings.getbool("wiretrace"),
+                federation_enabled=settings.get("federation") != "off")
     node.settings = settings
     node.dandelion.stem_probability = settings.getint("dandelion")
     node.processor.list_mode = settings.get("blackwhitelist")
@@ -124,6 +126,36 @@ async def run(args) -> int:
     FLIGHT_RECORDER.resize(settings.getint("flightrecsize"))
     node.health.sample_interval = settings.getfloat("healthinterval")
     node.health.probe.interval = settings.getfloat("looplaginterval")
+    # distributed observability plane (docs/observability.md): hashed
+    # peer-bucket label count, snapshot push cadence, optional parent
+    # aggregator this node federates its own registry up to
+    from .observability import set_peer_buckets
+    set_peer_buckets(settings.getint("peerlabelbuckets"))
+    if node.federation_publisher is not None:
+        node.federation_publisher.interval = \
+            settings.getfloat("federationinterval")
+        if settings.get("federationpush"):
+            from .observability import http_transport
+            host, _, port = settings.get("federationpush").rpartition(":")
+            parent = http_transport(
+                host or "127.0.0.1", int(port),
+                username=settings.get("apiusername"),
+                password=settings.get("apipassword"))
+            # tee: the push still lands in the LOCAL aggregator (this
+            # node's own /metrics/federated must keep including the
+            # local node) while the PARENT's ack drives the
+            # delta/resync bookkeeping.  Both see the same seq stream
+            # from seq 1, so their stored state cannot diverge.
+            local_ingest = (node.federation.ingest
+                            if node.federation is not None else None)
+
+            async def tee(push, _parent=parent, _local=local_ingest):
+                if _local is not None:
+                    _local(push)
+                return await _parent(push)
+
+            node.federation_publisher.transport = tee
+            node.federation_publisher.count_bytes = True  # real wire
     # ingest fast path knobs (docs/ingest.md) — applied before start()
     # spawns the pipeline workers
     node.processor.concurrency = settings.getint("ingestworkers")

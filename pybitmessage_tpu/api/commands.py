@@ -731,28 +731,55 @@ class CommandHandler:
         from ..observability import render_prometheus
         return render_prometheus()
 
+    def cmd_federatedStatus(self):
+        """Fleet view from the federation aggregator
+        (docs/observability.md): per-node health verdicts (pushed
+        ``observability/health.py`` blocks), last-push age, sequence,
+        clock-skew estimate, and an ok/degraded/stale roll-up.  The
+        merged metric families themselves are served as
+        ``GET /metrics/federated``."""
+        agg = getattr(self.node, "federation", None)
+        if agg is None:
+            return json.dumps({"enabled": False})
+        out = agg.status()
+        out["enabled"] = True
+        return json.dumps(out)
+
     def cmd_dumpFlightRecorder(self, kind=""):
         """Dump the flight-recorder ring (ISSUE 6): the last N
         structured events — breaker flips, chaos fires, ladder
         fallbacks, sync round verdicts, slab traffic, watermark
         pauses — newest last.  Also emits the dump as one structured
         log line (trigger=api).  Optional ``kind`` filters by event
-        kind."""
+        kind.  The dump carries the node id and its federation
+        clock-skew estimate so ``tools/flightrec_merge.py`` can fold
+        many nodes' dumps into one skew-normalized timeline."""
         from ..observability import FLIGHT_RECORDER
         events = FLIGHT_RECORDER.dump("api")
         if kind:
             events = [e for e in events if e.get("kind") == kind]
-        return json.dumps({"events": events}, default=repr)
+        return json.dumps({"node": FLIGHT_RECORDER.node_id,
+                           "skew": round(FLIGHT_RECORDER.skew(), 6),
+                           "events": events}, default=repr)
 
     def cmd_objectTimeline(self, hash_hex):
         """Lifecycle timeline of one inventory hash: the recorded
         stage events (received/parsed/decrypted/verified/stored/
-        announced/sync_pushed/delivered), oldest first."""
+        announced/sync_pushed/delivered), oldest first, plus the wire
+        trace-stitching metadata (trace id, local span, the sending
+        node's parent span) when the object crossed a NODE_TRACE
+        link."""
         if len(hash_hex) != 64:
             raise APIError(19)
         from ..observability import LIFECYCLE
-        return json.dumps(
-            {"timeline": LIFECYCLE.timeline(unhexlify(hash_hex))})
+        h = unhexlify(hash_hex)
+        out = {"timeline": LIFECYCLE.timeline(h)}
+        meta = LIFECYCLE.trace_meta(h)
+        if meta is not None:
+            out["trace"] = {"traceId": meta["trace_id"].hex(),
+                            "span": meta["span"],
+                            "parentSpan": meta["parent_span"]}
+        return json.dumps(out)
 
     def _pow_stats(self) -> dict:
         """Per-tier PoW stats for clientStatus, read from the metrics

@@ -133,15 +133,28 @@ class APIServer:
             if request_line.startswith(b"GET"):
                 path = request_line.split()[1].decode("latin-1", "replace") \
                     if len(request_line.split()) > 1 else ""
-                if path.split("?")[0] == "/metrics":
+                path = path.split("?")[0]
+                if path in ("/metrics", "/metrics/federated"):
                     if not self._authorized(headers):
                         await self._respond(
                             writer, 401, {"error": "unauthorized"},
                             extra="WWW-Authenticate: Basic\r\n")
                         return
-                    from ..observability import render_prometheus
+                    if path == "/metrics/federated":
+                        # the fleet-wide merged view (federation
+                        # aggregator); 404 when federation is off
+                        agg = getattr(self.node, "federation", None)
+                        if agg is None:
+                            await self._respond(
+                                writer, 404,
+                                {"error": "federation disabled"})
+                            return
+                        body_text = agg.render()
+                    else:
+                        from ..observability import render_prometheus
+                        body_text = render_prometheus()
                     await self._respond_raw(
-                        writer, 200, render_prometheus().encode("utf-8"),
+                        writer, 200, body_text.encode("utf-8"),
                         "text/plain; version=0.0.4; charset=utf-8")
                     return
                 await self._respond(writer, 404, {"error": "not found"})
@@ -153,6 +166,26 @@ class APIServer:
             if not self._authorized(headers):
                 await self._respond(writer, 401, {"error": "unauthorized"},
                                     extra="WWW-Authenticate: Basic\r\n")
+                return
+            post_path = request_line.split()[1].decode(
+                "latin-1", "replace").split("?")[0] \
+                if len(request_line.split()) > 1 else ""
+            if post_path == "/federation/push":
+                # child processes / peer nodes push delta-encoded
+                # registry snapshots here (docs/observability.md); the
+                # ack drives their delta/resync bookkeeping
+                agg = getattr(self.node, "federation", None)
+                if agg is None:
+                    await self._respond(
+                        writer, 404, {"error": "federation disabled"})
+                    return
+                try:
+                    push = json.loads(body)
+                except Exception:
+                    await self._respond(writer, 400,
+                                        {"error": "bad json"})
+                    return
+                await self._respond(writer, 200, agg.ingest(push))
                 return
             is_xml = body.lstrip().startswith(b"<") or \
                 "xml" in headers.get("content-type", "")

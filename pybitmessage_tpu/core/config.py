@@ -129,6 +129,22 @@ DEFAULTS: dict[str, str] = {
                                      # seconds
     "looplaginterval": "0.25",       # event-loop lag probe cadence,
                                      # seconds
+    # -- distributed observability plane (docs/observability.md) --
+    "wiretrace": "true",             # advertise NODE_TRACE: carry
+                                     # trace contexts on sync rounds +
+                                     # object pushes (legacy peers see
+                                     # nothing)
+    "federation": "aggregator",      # off | aggregator (merge pushed
+                                     # snapshots, serve the fleet view)
+    "federationinterval": "10",      # self/child snapshot push
+                                     # cadence, seconds
+    "federationpush": "",            # parent aggregator "host:port" to
+                                     # push this node's snapshots to
+                                     # (basic auth from apiusername/
+                                     # apipassword; empty = no parent)
+    "peerlabelbuckets": "16",        # hashed peer-bucket count for
+                                     # per-peer metric labels
+                                     # (sync.reconcile/bNN et al.)
     "blackwhitelist": "black",       # inbound sender policy
     # ceilings on recipient-demanded PoW; 0 = unlimited (reference
     # helper_startup sanity cap: ridiculousDifficulty x network default)
@@ -200,6 +216,13 @@ VALIDATORS: dict[str, Callable[[str], bool]] = {
     "flightrecsize": _validate_int_range(16, 1 << 20),
     "healthinterval": _validate_float_range(0.1, 3600.0),
     "looplaginterval": _validate_float_range(0.01, 60.0),
+    "wiretrace": _validate_bool,
+    "federation": lambda v: v in ("off", "aggregator"),
+    "federationinterval": _validate_float_range(0.5, 3600.0),
+    "federationpush": lambda v: v == "" or (
+        v.rpartition(":")[2].isdigit()
+        and 1 <= int(v.rpartition(":")[2]) <= 65535),
+    "peerlabelbuckets": _validate_int_range(1, 512),
     "apienabled": _validate_bool,
     "notifysound": _validate_bool,
     "smtpdenabled": _validate_bool,

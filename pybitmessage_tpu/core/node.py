@@ -42,7 +42,9 @@ class Node:
                  tls_enabled: bool = True, udp_enabled: bool = False,
                  inventory_backend: str = "sqlite",
                  pow_window: float | None = None,
-                 sync_enabled: bool = True):
+                 sync_enabled: bool = True,
+                 wiretrace_enabled: bool = True,
+                 federation_enabled: bool = True):
         self.data_dir = Path(data_dir) if data_dir else None
         if self.data_dir:
             self.data_dir.mkdir(parents=True, exist_ok=True)
@@ -158,6 +160,41 @@ class Node:
         #: per-subsystem block clientStatus serves
         from ..observability import HealthMonitor
         self.health = HealthMonitor(self)
+        #: distributed observability plane (docs/observability.md)
+        self.node_id = self.ctx.nonce.hex()
+        if wiretrace_enabled:
+            # NODE_TRACE: sync rounds + object pushes carry trace
+            # contexts to negotiating peers; legacy peers see nothing
+            from ..models.constants import NODE_TRACE
+            self.ctx.services |= NODE_TRACE
+        #: fleet aggregator + this node's own snapshot publisher.  The
+        #: aggregator merges pushes from child processes/peers (POST
+        #: /federation/push) and this process publishes itself into it
+        #: in-process, so `GET /metrics/federated` / `federatedStatus`
+        #: always include at least the local node.
+        self.federation = None
+        self.federation_publisher = None
+        if federation_enabled:
+            from ..observability import (FLIGHT_RECORDER, Aggregator,
+                                         FederationPublisher)
+            self.federation = Aggregator()
+            self.federation_publisher = FederationPublisher(
+                self.node_id, transport=self.federation.ingest,
+                health=self.health.health_block, skew=self.mean_skew,
+                # in-process transport: no wire bytes to account for
+                count_bytes=False)
+            FLIGHT_RECORDER.node_id = self.node_id
+            FLIGHT_RECORDER.skew_provider = self.mean_skew
+
+    def mean_skew(self) -> float:
+        """This node's clock-offset estimate vs its peers: the mean of
+        the per-connection wire-trace skew estimators (0.0 without
+        samples) — recorded in snapshot pushes and flight dumps so
+        multi-node telemetry normalizes onto one clock."""
+        offsets = [c.skew.offset() for c in self.pool.established()
+                   if getattr(c, "skew", None) is not None
+                   and c.skew.samples]
+        return sum(offsets) / len(offsets) if offsets else 0.0
 
     def _solve(self, initial_hash, target, should_stop=None):
         return self.solver(initial_hash, target, should_stop=should_stop)
@@ -180,6 +217,8 @@ class Node:
         from ..observability import log_snapshot_task
         self._metrics_task = asyncio.create_task(log_snapshot_task(60.0))
         self.health.start()
+        if self.federation_publisher is not None:
+            self.federation_publisher.start()
         logger.info("node started (port %s)",
                     self.pool.listen_port if self.listen else "-")
 
@@ -192,6 +231,8 @@ class Node:
     async def stop(self) -> None:
         """Orderly shutdown (reference shutdown.py:19-91)."""
         self.shutdown.set()
+        if self.federation_publisher is not None:
+            await self.federation_publisher.stop()
         await self.health.stop()
         if self._pump_task:
             self._pump_task.cancel()

@@ -20,7 +20,7 @@ from typing import TYPE_CHECKING
 
 from ..models.constants import (
     MAGIC, MAX_MESSAGE_SIZE, MAX_OBJECT_COUNT, MAX_TIME_OFFSET,
-    NODE_DANDELION, NODE_SSL, NODE_SYNC, PROTOCOL_VERSION,
+    NODE_DANDELION, NODE_SSL, NODE_SYNC, NODE_TRACE, PROTOCOL_VERSION,
 )
 from ..models.objects import ObjectError, ObjectHeader, check_by_type
 from ..models.packet import (
@@ -28,13 +28,19 @@ from ..models.packet import (
 )
 from ..models.pow_math import check_pow
 from ..observability import REGISTRY
+from ..observability.lifecycle import LIFECYCLE
+from ..observability.tracing import (
+    TRACE_CTX_INVALID, TRACE_CTX_LEN, TRACE_CTX_RECEIVED, TRACE_CTX_SENT,
+    SkewEstimator, TraceContext,
+)
 from ..resilience import inject
 from ..resilience.policy import ERRORS
 from ..utils.hashes import inventory_hash
 from ..utils.varint import VarintError
 from .messages import (
-    AddrEntry, MessageError, VersionPayload, decode_addr, decode_inv,
-    encode_addr, encode_error, encode_host, encode_inv,
+    AddrEntry, MessageError, VersionPayload, append_trace_ctx,
+    decode_addr, decode_inv, encode_addr, encode_error, encode_host,
+    encode_inv, split_trace_ctx,
 )
 from .tracker import ConnectionTracker
 
@@ -102,6 +108,10 @@ class BMConnection:
         #: (antiIntersectionDelay, reference tcp.py:96-127)
         self.skip_until = 0.0
         self._connected_at = time.time()
+        #: bounded per-connection clock-offset estimator, fed by the
+        #: send timestamps of incoming wire trace contexts — what makes
+        #: cross-node stage latencies meaningful (docs/observability.md)
+        self.skew = SkewEstimator()
         #: bounded in-flight object-verification pipeline (per peer)
         self._verify_sem = asyncio.Semaphore(VERIFY_WINDOW)
         self._verify_tasks: set[asyncio.Task] = set()
@@ -482,12 +492,87 @@ class BMConnection:
             except KeyError:
                 self._anti_intersection_delay()
                 continue
-            await self.send_packet("object", item.payload)
+            await self.send_object(h, item.payload)
             self.tracker.object_received(h)
             served += 1
 
+    # -- wire trace context (docs/observability.md) --------------------------
+
+    @property
+    def trace_negotiated(self) -> bool:
+        """Both ends advertised NODE_TRACE: sync payloads carry the
+        32-byte trace trailer and object pushes travel as ``tobject``.
+        Legacy peers (no bit) see the classic wire format, byte for
+        byte."""
+        return bool(self.services & NODE_TRACE
+                    and self.ctx.services & NODE_TRACE)
+
+    def attach_trace(self, command: str, payload: bytes) -> bytes:
+        """Append the trace trailer for a sync-round payload when the
+        peer negotiated NODE_TRACE (reconciler send hook; simulated
+        connections simply lack this method)."""
+        if not self.trace_negotiated:
+            return payload
+        ctx = TraceContext(self.ctx.nonce.ljust(16, b"\x00"), 0)
+        TRACE_CTX_SENT.labels(command=command).inc()
+        return append_trace_ctx(payload, ctx)
+
+    def _strip_trace(self, command: str, payload: bytes) -> bytes:
+        """Split and consume an incoming sync payload's trace trailer:
+        feed the skew estimator, count it, hand back the bare payload.
+        A malformed trailer is dropped (counted) without killing the
+        round — telemetry must not break sync."""
+        if not self.trace_negotiated:
+            return payload
+        try:
+            payload, ctx = split_trace_ctx(payload)
+        except MessageError:
+            TRACE_CTX_INVALID.inc()
+            return payload
+        TRACE_CTX_RECEIVED.labels(command=command).inc()
+        self.skew.observe(ctx.sent_at)
+        return payload
+
+    async def send_object(self, h: bytes, payload: bytes) -> None:
+        """Push one object: a ``tobject`` frame (32-byte trace context
+        + object payload) to NODE_TRACE peers so the receiver's
+        lifecycle timeline joins this object's trace, the classic
+        ``object`` frame otherwise."""
+        if not self.trace_negotiated:
+            await self.send_packet("object", payload)
+            return
+        ctx = LIFECYCLE.trace_ctx_for(h)
+        if ctx is None:
+            await self.send_packet("object", payload)
+            return
+        TRACE_CTX_SENT.labels(command="tobject").inc()
+        await self.send_packet("tobject", ctx.encode() + payload)
+
+    async def cmd_tobject(self, payload: bytes) -> None:
+        """A trace-carrying object push.  Only trace-negotiated peers
+        send these; from anyone else the command is ignored like any
+        unknown command would be (the object will arrive again through
+        normal paths)."""
+        self._require_established()
+        if not self.trace_negotiated or len(payload) <= TRACE_CTX_LEN:
+            logger.debug("tobject from %s without negotiation; ignored",
+                         self.host)
+            return
+        try:
+            ctx = TraceContext.decode(payload[:TRACE_CTX_LEN])
+        except ValueError:
+            TRACE_CTX_INVALID.inc()
+            return
+        TRACE_CTX_RECEIVED.labels(command="tobject").inc()
+        self.skew.observe(ctx.sent_at)
+        await self._handle_object(payload[TRACE_CTX_LEN:], trace_ctx=ctx)
+
     async def cmd_object(self, payload: bytes) -> None:
         self._require_established()
+        await self._handle_object(payload)
+
+    async def _handle_object(self, payload: bytes,
+                             trace_ctx: TraceContext | None = None) -> None:
         try:
             header = ObjectHeader.parse(payload)
             check_by_type(header.object_type, header.version, len(payload))
@@ -506,7 +591,7 @@ class BMConnection:
             # round-trip and starve the batching entirely.
             await self._verify_sem.acquire()
             task = asyncio.create_task(
-                self._verify_and_accept(header, payload))
+                self._verify_and_accept(header, payload, trace_ctx))
             self._verify_tasks.add(task)
             task.add_done_callback(self._verify_task_done)
         else:
@@ -515,7 +600,7 @@ class BMConnection:
             if not ok:
                 logger.debug("insufficient PoW from %s", self.host)
                 raise ConnectionClosed("object with insufficient PoW")
-            self._accept_object(header, payload)
+            self._accept_object(header, payload, trace_ctx)
 
     def _verify_task_done(self, task: asyncio.Task) -> None:
         self._verify_tasks.discard(task)
@@ -529,16 +614,24 @@ class BMConnection:
             logger.error("object acceptance failed on %s:%s",
                          self.host, self.port, exc_info=exc)
 
-    async def _verify_and_accept(self, header, payload: bytes) -> None:
+    async def _verify_and_accept(self, header, payload: bytes,
+                                 trace_ctx=None) -> None:
         ok = await self.ctx.pow_verifier.check(payload)
         if not ok:
             logger.debug("insufficient PoW from %s", self.host)
             await self.close()
             return
-        self._accept_object(header, payload)
+        self._accept_object(header, payload, trace_ctx)
 
-    def _accept_object(self, header, payload: bytes) -> None:
+    def _accept_object(self, header, payload: bytes,
+                       trace_ctx=None) -> None:
         h = inventory_hash(payload)
+        if trace_ctx is not None:
+            # the object arrived inside another node's trace: this
+            # node's lifecycle timeline joins it (stitching) instead of
+            # opening a fresh one
+            LIFECYCLE.adopt(h, trace_ctx.trace_id,
+                            trace_ctx.parent_span)
         self.tracker.object_received(h)
         self.ctx.global_tracker.received(h)
         if h in self.ctx.inventory:
@@ -567,18 +660,21 @@ class BMConnection:
 
     async def cmd_sketchreq(self, payload: bytes) -> None:
         self._require_established()
+        payload = self._strip_trace("sketchreq", payload)
         rec = self._reconciler()
         if rec is not None:
             await rec.handle_sketchreq(self, payload)
 
     async def cmd_sketch(self, payload: bytes) -> None:
         self._require_established()
+        payload = self._strip_trace("sketch", payload)
         rec = self._reconciler()
         if rec is not None:
             await rec.handle_sketch(self, payload)
 
     async def cmd_recondiff(self, payload: bytes) -> None:
         self._require_established()
+        payload = self._strip_trace("recondiff", payload)
         rec = self._reconciler()
         if rec is not None:
             await rec.handle_recondiff(self, payload)

@@ -369,6 +369,35 @@ def decode_recondiff(data: bytes):
     return flags, salt, diff_size, missing, want
 
 
+# -- wire trace context (docs/observability.md) ------------------------------
+#
+# NODE_TRACE peers append a fixed 32-byte trailer (16B trace id + 8B
+# parent span + 8B send-time micros) to sync-round payloads, and push
+# objects as `tobject` frames (trailer-prefixed object payload).  The
+# trailer travels ONLY between peers that both advertised NODE_TRACE,
+# so legacy decoders never see the extra bytes.
+
+def append_trace_ctx(payload: bytes, ctx) -> bytes:
+    """``payload + ctx.encode()`` (ctx stamped with the send time)."""
+    from ..observability.tracing import TraceContext
+    return payload + TraceContext(ctx.trace_id, ctx.parent_span).encode()
+
+
+def split_trace_ctx(payload: bytes):
+    """Inverse of :func:`append_trace_ctx`: ``(payload, ctx)``.
+    Raises :class:`MessageError` when the trailer cannot be there —
+    callers only split on trace-negotiated connections, where every
+    sync payload carries it."""
+    from ..observability.tracing import TRACE_CTX_LEN, TraceContext
+    if len(payload) < TRACE_CTX_LEN:
+        raise MessageError("payload too short for a trace trailer")
+    try:
+        ctx = TraceContext.decode(payload[-TRACE_CTX_LEN:])
+    except ValueError as exc:
+        raise MessageError("bad trace trailer: %s" % exc) from exc
+    return payload[:-TRACE_CTX_LEN], ctx
+
+
 def encode_error(fatal: int = 0, ban_time: int = 0,
                  inventory_vector: bytes = b"", text: str = "") -> bytes:
     t = text.encode("utf-8")

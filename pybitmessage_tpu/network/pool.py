@@ -254,11 +254,14 @@ class ConnectionPool:
         if br is None:
             while len(self._dial_breakers) >= MAX_DIAL_BREAKERS:
                 self._dial_breakers.pop(next(iter(self._dial_breakers)))
+            # hashed peer-bucket label (``net.dial/bNN``): per-bucket
+            # visibility without per-peer label cardinality
+            from ..observability.metrics import peer_bucket_label
             br = self._dial_breakers[key] = CircuitBreaker(
                 "net.dial:%s" % key,
                 threshold=self.dial_breaker_threshold,
                 cooldown=self.dial_breaker_cooldown,
-                label="net.dial", register=False)
+                label=peer_bucket_label("net.dial", key), register=False)
         return br
 
     async def connect_to(self, peer: Peer) -> BMConnection | None:

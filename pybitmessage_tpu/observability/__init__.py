@@ -6,23 +6,33 @@ See docs/observability.md for the full catalog of exported metrics.
 
 from .export import (escape_help, escape_label_value, log_snapshot_task,
                      render_prometheus, snapshot)
+from .federation import (FEDERATION_VERSION, Aggregator,
+                         FederationPublisher, http_transport,
+                         mergeable_snapshot)
 from .flightrec import FLIGHT_RECORDER, FlightRecorder
 from .health import HealthMonitor, LoopLagProbe
 from .lifecycle import LIFECYCLE, LifecycleTracer
 from .metrics import (DEFAULT_LATENCY_BUCKETS, DEFAULT_SIZE_BUCKETS,
-                      REGISTRY, Counter, Gauge, Histogram, Registry)
-from .tracing import (TRACER, Span, Tracer, current_span,
+                      REGISTRY, Counter, Gauge, Histogram, Registry,
+                      peer_bucket, peer_bucket_label, set_peer_buckets)
+from .tracing import (TRACE_CTX_LEN, TRACER, SkewEstimator, Span,
+                      TraceContext, Tracer, current_span,
                       enable_jax_annotations, jax_annotations_enabled,
-                      trace)
+                      new_span_id, new_trace_id, trace)
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "Registry", "REGISTRY",
     "DEFAULT_LATENCY_BUCKETS", "DEFAULT_SIZE_BUCKETS",
+    "peer_bucket", "peer_bucket_label", "set_peer_buckets",
     "Span", "Tracer", "TRACER", "trace", "current_span",
     "enable_jax_annotations", "jax_annotations_enabled",
+    "TraceContext", "TRACE_CTX_LEN", "SkewEstimator",
+    "new_trace_id", "new_span_id",
     "render_prometheus", "snapshot", "log_snapshot_task",
     "escape_help", "escape_label_value",
     "LifecycleTracer", "LIFECYCLE",
     "FlightRecorder", "FLIGHT_RECORDER",
     "HealthMonitor", "LoopLagProbe",
+    "Aggregator", "FederationPublisher", "FEDERATION_VERSION",
+    "http_transport", "mergeable_snapshot",
 ]

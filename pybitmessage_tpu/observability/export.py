@@ -29,8 +29,14 @@ def render_prometheus(registry: Registry = None) -> str:
     return (registry or REGISTRY).render()
 
 
-def snapshot(registry: Registry = None) -> dict:
-    """``{metric_name: {type, series: [{labels, ...values}]}}``."""
+def snapshot(registry: Registry = None,
+             include_buckets: bool = False) -> dict:
+    """``{metric_name: {type, series: [{labels, ...values}]}}``.
+
+    ``include_buckets=True`` adds raw bucket bounds/counts to each
+    histogram series — the lossless shape downstream mergers need
+    (``observability/federation.py`` carries its own wire variant);
+    the default stays the compact human/bench view."""
     out = {}
     for fam in (registry or REGISTRY).families():
         series = []
@@ -38,12 +44,16 @@ def snapshot(registry: Registry = None) -> dict:
             labels = dict(zip(fam.labelnames, values))
             if isinstance(fam, Histogram):
                 counts, total_sum, total = child.snapshot()
-                series.append({
+                entry = {
                     "labels": labels, "count": total,
                     "sum": round(total_sum, 9),
                     "p50": round(child.percentile(0.50), 9),
                     "p90": round(child.percentile(0.90), 9),
-                    "p99": round(child.percentile(0.99), 9)})
+                    "p99": round(child.percentile(0.99), 9)}
+                if include_buckets:
+                    entry["buckets"] = list(fam._bounds)
+                    entry["bucketCounts"] = counts
+                series.append(entry)
             else:
                 series.append({"labels": labels, "value": child.value})
         out[fam.name] = {"type": fam.kind, "series": series}

@@ -52,6 +52,15 @@ class FlightRecorder:
         #: AND from dispatcher/watchdog threads)
         self._seq = itertools.count(1)
         self.enabled = True
+        #: this process's identity in multi-node dumps (the node nonce
+        #: hex; set by Node) — "" until wired
+        self.node_id = ""
+        #: optional callable returning this node's estimated clock
+        #: offset vs its peers (remote-minus-local seconds, from the
+        #: federation/wire-trace skew estimators).  Recorded in every
+        #: dump so tools/flightrec_merge.py can emit ONE skew-
+        #: normalized timeline from many nodes' dumps.
+        self.skew_provider = None
 
     def resize(self, maxlen: int) -> None:
         """Re-cap the ring, keeping the newest events."""
@@ -85,18 +94,38 @@ class FlightRecorder:
     def clear(self) -> None:
         self._ring.clear()
 
+    def skew(self) -> float:
+        """This node's estimated clock offset (0.0 when unwired or the
+        provider fails — a dump must never fail on telemetry)."""
+        if self.skew_provider is None:
+            return 0.0
+        try:
+            return float(self.skew_provider())
+        except Exception:
+            logger.debug("flightrec skew provider failed", exc_info=True)
+            return 0.0
+
+    def dump_record(self, trigger: str) -> dict:
+        """The full dump structure: node identity + the federation
+        clock-skew estimate + the ring.  Multi-node dumps interleave
+        with raw local timestamps; the recorded ``skew`` is what lets
+        ``tools/flightrec_merge.py`` normalize them onto one clock."""
+        return {"trigger": trigger, "node": self.node_id,
+                "skew": round(self.skew(), 6), "events": self.events()}
+
     def dump(self, trigger: str, *, log: logging.Logger | None = None
              ) -> list[dict]:
         """Emit the whole ring as one structured log line and return
         the events.  ``trigger`` names why (stall/fatal/api) — every
         dump is counted so post-mortems know whether the black box
         fired at all."""
-        events = self.events()
+        record = self.dump_record(trigger)
+        events = record["events"]
         DUMPS.labels(trigger=trigger).inc()
         try:
             (log or logger).warning(
                 "flightrec_dump trigger=%s events=%d %s", trigger,
-                len(events), json.dumps(events, default=repr))
+                len(events), json.dumps(record, default=repr))
         except Exception:  # pragma: no cover
             logger.exception("flight recorder dump failed")
         return events

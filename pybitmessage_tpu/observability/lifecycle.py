@@ -84,6 +84,10 @@ class LifecycleTracer:
         self._lock = threading.Lock()
         #: hash -> list[(stage, t)] in arrival order (LRU by insertion)
         self._timelines: "OrderedDict[bytes, list]" = OrderedDict()
+        #: hash -> wire-trace metadata {trace_id, span, parent_span} —
+        #: populated lazily (only traced objects pay for it), evicted
+        #: alongside the timeline
+        self._trace_meta: dict[bytes, dict] = {}
         #: incremental per-stage event counts over retained timelines —
         #: snapshot() must be O(stages), not a full scan under the
         #: hot-path lock
@@ -106,8 +110,9 @@ class LifecycleTracer:
                 timeline = self._timelines.get(h)
                 if timeline is None:
                     while len(self._timelines) >= self.maxlen:
-                        _, old = self._timelines.popitem(last=False)
+                        old_h, old = self._timelines.popitem(last=False)
                         self._uncount(old)
+                        self._trace_meta.pop(old_h, None)
                         EVICTED.inc()
                     timeline = self._timelines[h] = []
                 prev = timeline[-1] if timeline else None
@@ -173,10 +178,70 @@ class LifecycleTracer:
     def discard(self, h) -> None:
         with self._lock:
             timeline = self._timelines.pop(h, None)
+            self._trace_meta.pop(h, None)
             if timeline is not None:
                 self._uncount(timeline)
                 if self._update_gauge:
                     TRACKED.set(len(self._timelines))
+
+    # -- wire trace stitching (distributed observability plane) --------------
+
+    def adopt(self, h, trace_id: bytes, parent_span: int = 0) -> None:
+        """Bind ``h`` to a trace that originated on ANOTHER node: the
+        object arrived with a wire trace context, so this node's
+        timeline joins the sender's trace instead of opening a new one.
+        First writer wins — an object's origin trace is never
+        overwritten by a later duplicate push.  Never raises."""
+        if not self.enabled or h is None:
+            return
+        try:
+            with self._lock:
+                meta = self._trace_meta.get(h)
+                if meta is None:
+                    from .tracing import new_span_id
+                    self._bound_trace_meta()
+                    self._trace_meta[h] = {
+                        "trace_id": bytes(trace_id),
+                        "span": new_span_id(),
+                        "parent_span": int(parent_span)}
+        except Exception:  # pragma: no cover — telemetry never kills
+            logger.debug("lifecycle adopt failed", exc_info=True)
+
+    def trace_ctx_for(self, h):
+        """The :class:`~.tracing.TraceContext` to attach when pushing
+        ``h`` to a NODE_TRACE peer: the object's adopted trace id (a
+        fresh one if this node is the origin) with THIS node's span as
+        the receiver's parent.  Returns None only on internal failure
+        (the push then simply goes untraced)."""
+        if h is None:
+            return None
+        try:
+            from .tracing import TraceContext, new_span_id, new_trace_id
+            with self._lock:
+                meta = self._trace_meta.get(h)
+                if meta is None:
+                    self._bound_trace_meta()
+                    meta = self._trace_meta[h] = {
+                        "trace_id": new_trace_id(),
+                        "span": new_span_id(),
+                        "parent_span": 0}
+            return TraceContext(meta["trace_id"], meta["span"])
+        except Exception:  # pragma: no cover
+            logger.debug("lifecycle trace_ctx_for failed", exc_info=True)
+            return None
+
+    def _bound_trace_meta(self) -> None:
+        # caller holds the lock.  Metadata normally dies with its
+        # timeline's eviction, but trace_ctx_for can mint entries for
+        # hashes that never grow one — cap those independently.
+        while len(self._trace_meta) >= 2 * self.maxlen:
+            self._trace_meta.pop(next(iter(self._trace_meta)))
+
+    def trace_meta(self, h) -> dict | None:
+        """The stitching metadata of one object (None when untraced)."""
+        with self._lock:
+            meta = self._trace_meta.get(h)
+            return dict(meta) if meta is not None else None
 
     def _uncount(self, timeline) -> None:
         # caller holds the lock

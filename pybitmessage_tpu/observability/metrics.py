@@ -52,6 +52,46 @@ DEFAULT_SIZE_BUCKETS = tuple(float(1 << i) for i in range(11))
 #: must not crash the hot path it observes.
 MAX_LABEL_SETS = 512
 
+#: default hashed peer-bucket count for :func:`peer_bucket` — at lab
+#: scale (hundreds of peers) raw per-peer label values blow through
+#: :data:`MAX_LABEL_SETS` and silently collapse into the overflow
+#: child; hashing peers into a bounded bucket set keeps per-peer-group
+#: visibility at fixed cardinality (``peerlabelbuckets`` setting)
+DEFAULT_PEER_BUCKETS = 16
+
+_peer_buckets = DEFAULT_PEER_BUCKETS
+
+
+def set_peer_buckets(n: int) -> None:
+    """Configure the hashed peer-bucket count (>=1)."""
+    global _peer_buckets
+    _peer_buckets = max(1, int(n))
+
+
+def peer_buckets() -> int:
+    return _peer_buckets
+
+
+def peer_bucket(peer: str, buckets: int | None = None) -> str:
+    """Stable hashed bucket label for a peer identity.
+
+    ``"host:port" -> "b07"`` — deterministic across processes (CRC32,
+    not the salted builtin ``hash``) so the same peer lands in the
+    same bucket on every node, and bounded so per-peer series can
+    never approach the cardinality guard."""
+    import zlib
+    n = buckets if buckets is not None else _peer_buckets
+    return "b%02d" % (zlib.crc32(str(peer).encode("utf-8", "replace"))
+                      % max(1, n))
+
+
+def peer_bucket_label(site: str, peer: str,
+                      buckets: int | None = None) -> str:
+    """``site/bNN`` — the shared-label convention the per-peer breaker
+    families use: per-bucket visibility, ``sites x buckets`` bounded
+    cardinality."""
+    return "%s/%s" % (site, peer_bucket(peer, buckets))
+
 
 def _fmt(v: float) -> str:
     """Prometheus sample value / le formatting: integers stay integral

@@ -77,6 +77,10 @@ class _Request:
     attempts: int = 0
     #: monotonic time of the last journal checkpoint (write throttle)
     last_checkpoint: float = field(default=0.0)
+    #: wire trace id this job belongs to (hex prefix in flight events;
+    #: the future solver-farm protocol carries it on submit/requeue so
+    #: a job's path through a remote farm stays one causal trace)
+    trace_id: bytes = b""
 
 
 class PowService:
@@ -191,6 +195,12 @@ class PowService:
         # their pre-nonce initial hash (the inventory hash only exists
         # after the winning nonce is prepended)
         LIFECYCLE.record(initial_hash, "pow_queued")
+        # the job joins (or opens) the object's wire trace: submit and
+        # every requeue carry the id, so a job bounced between
+        # processes remains one causal trace
+        ctx = LIFECYCLE.trace_ctx_for(initial_hash)
+        if ctx is not None:
+            req.trace_id = ctx.trace_id
         await self.queue.put(req)
         QUEUE_DEPTH.set(self.queue.qsize())
         return await fut
@@ -254,9 +264,15 @@ class PowService:
                 if not req.future.done():
                     req.future.set_result(res)
 
+    @staticmethod
+    def _trace_ids(batch: list[_Request]) -> list[str]:
+        """Short trace-id prefixes for flight events (bounded)."""
+        return [r.trace_id.hex()[:8] for r in batch[:8] if r.trace_id]
+
     def _settle_interrupted(self, batch: list[_Request]) -> None:
         REQUEUED.labels(reason="interrupt").inc(len(batch))
-        _flight("pow_requeue", reason="interrupt", n=len(batch))
+        _flight("pow_requeue", reason="interrupt", n=len(batch),
+                traces=self._trace_ids(batch))
         for req in batch:
             if req.job_id is not None:
                 self._journal_call(
@@ -292,7 +308,8 @@ class PowService:
             return
         REQUEUED.labels(reason="failure").inc(len(survivors))
         _flight("pow_requeue", reason="failure", n=len(survivors),
-                error=repr(exc)[:120])
+                error=repr(exc)[:120],
+                traces=self._trace_ids(survivors))
         attempt = min(r.attempts for r in survivors) - 1
         pause = self.retry.delay(attempt)
         logger.warning(

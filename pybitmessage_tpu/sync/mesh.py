@@ -15,11 +15,14 @@ comparison is honest about overheads.
 
 from __future__ import annotations
 
+import time
 from collections import deque
 
 from ..network.messages import decode_inv, encode_inv
 from ..network.tracker import ConnectionTracker, GlobalTracker
+from ..observability.federation import Aggregator, FederationPublisher
 from ..observability.lifecycle import LifecycleTracer
+from ..observability.metrics import Registry
 from .digest import InventoryDigest
 from .reconciler import FRAME_OVERHEAD, Reconciler
 
@@ -29,6 +32,12 @@ SIM_OBJECT_SIZE = 256
 #: commands that form the announcement layer (the quantity sync is
 #: built to shrink); getdata/object transfer is identical in both modes
 ANNOUNCE_COMMANDS = ("inv", "sketchreq", "sketch", "recondiff")
+
+#: tick-resolution buckets for the per-node propagation histogram the
+#: federation path merges (one mesh tick == one simulated second)
+TICK_BUCKETS = tuple(float(b) for b in (
+    1, 2, 3, 4, 5, 6, 8, 10, 12, 16, 20, 24, 32, 48, 64, 96, 128,
+    192, 256))
 
 
 class MeshStats:
@@ -101,6 +110,9 @@ class SimConn:
 
     async def send_packet(self, command: str, payload: bytes = b"") -> None:
         self.mesh.stats.count(command, payload)
+        if self.node._announce_bytes is not None and \
+                command in ANNOUNCE_COMMANDS:
+            self.node._announce_bytes.inc(len(payload) + FRAME_OVERHEAD)
         self.mesh.queue.append((self.peer, self.node, command, payload))
 
     async def announce(self, hashes, stem: bool = False) -> None:
@@ -118,6 +130,36 @@ class SimNode:
         self.global_tracker = GlobalTracker()
         self.reconciler: Reconciler | None = None
         self.digest: InventoryDigest | None = None
+        #: per-node telemetry (federation mode): a PRIVATE registry —
+        #: this node's propagation/byte/delivery series, pushed to the
+        #: mesh aggregator through the real FederationPublisher path
+        self.registry: Registry | None = None
+        self.publisher: FederationPublisher | None = None
+        self._prop_hist = None
+        self._announce_bytes = None
+        self._delivered = None
+
+    def enable_federation(self, aggregator: Aggregator) -> None:
+        """Give this node its own registry + lifecycle tracer and a
+        real publisher into the mesh aggregator — the same snapshot
+        push/merge machinery a multi-process deployment runs, driven
+        in-process (the scenario-lab shape, ROADMAP item 5)."""
+        self.registry = Registry()
+        self._prop_hist = self.registry.histogram(
+            "mesh_propagation_seconds",
+            "Origin-to-this-node delivery latency (simulated ticks)",
+            buckets=TICK_BUCKETS)
+        self._announce_bytes = self.registry.counter(
+            "mesh_announce_bytes_total",
+            "Announcement-layer bytes this node sent")
+        self._delivered = self.registry.counter(
+            "mesh_delivered_objects_total",
+            "Objects delivered to this node from a peer")
+        self.publisher = FederationPublisher(
+            "sim-%d" % self.index, self.registry,
+            transport=aggregator.ingest, count_bytes=False,
+            health=lambda: {"mesh": {"status": "ok",
+                                     "inventory": len(self.inventory)}})
 
     def enable_sync(self, **kwargs) -> Reconciler:
         kwargs.setdefault("clock", lambda: float(self.mesh._tick_no))
@@ -143,6 +185,17 @@ class SimNode:
         if source is not None:
             self.mesh.stats.deliveries += 1
             self.mesh.lifecycle.observe_propagation(h)
+            if self._delivered is not None:
+                # per-node telemetry (federation mode): this node's own
+                # series — delivery count + origin-to-here latency
+                # against the object's origin stamp (the simulated
+                # stand-in for the wire trace context; every simulated
+                # node shares one tick clock, so no skew term)
+                self._delivered.inc()
+                origin = self.mesh.origin_tick.get(h)
+                if origin is not None and self._prop_hist is not None:
+                    self._prop_hist.observe(
+                        float(self.mesh._tick_no - origin))
         targets = [c for c in self.conns.values() if c is not source]
         if self.reconciler is not None:
             self.reconciler.route_announcement(h, targets)
@@ -209,9 +262,26 @@ class Mesh:
 
     def __init__(self, n: int, *, edges=None, sync: bool = False,
                  fanout: int = 0, sync_every: int = 1,
-                 buckets: int = 2):
+                 buckets: int = 2, federation: bool = False,
+                 federate_every: int = 8):
         self.stats = MeshStats()
         self.queue: deque = deque()
+        #: federation mode (distributed observability plane): every
+        #: node runs its own registry + a real FederationPublisher
+        #: pushing delta snapshots into one Aggregator every
+        #: ``federate_every`` ticks — the same code path a
+        #: multi-process deployment runs, so the merged propagation /
+        #: bytes-per-object figures bench reports come from FEDERATED
+        #: snapshots, not mesh-global bookkeeping
+        self.aggregator: Aggregator | None = None
+        self.federate_every = max(1, federate_every)
+        #: wall seconds spent inside the federation path (snapshot
+        #: build + push + ingest) — the direct overhead measurement
+        #: the <2% perfguard band reads
+        self.federation_seconds = 0.0
+        #: origin tick per injected object (the sim's stand-in for the
+        #: wire trace context's origin stamp; one shared tick clock)
+        self.origin_tick: dict[bytes, int] = {}
         #: reconciler.tick() runs every Nth mesh tick.  The reconciler
         #: itself staggers rounds (one least-recently-reconciled peer
         #: per tick), which sets the real per-pair cadence — the gap
@@ -253,6 +323,10 @@ class Mesh:
                                  round_timeout=300.0,
                                  breaker_cooldown=0.2,
                                  recent_window=8.0)
+        if federation:
+            self.aggregator = Aggregator(max_nodes=max(n + 1, 4096))
+            for node in self.nodes:
+                node.enable_federation(self.aggregator)
 
     def inject(self, origin: int, h: bytes,
                payload: bytes | None = None) -> None:
@@ -260,6 +334,7 @@ class Mesh:
         if payload is None:
             payload = h + b"\xAA" * max(0, SIM_OBJECT_SIZE - 32)
         self.lifecycle.record(h, "received")
+        self.origin_tick[h] = self._tick_no
         self.nodes[origin].add_object(h, payload, source=None)
 
     def seed(self, node: int, hashes) -> None:
@@ -272,21 +347,26 @@ class Mesh:
             if n.digest is not None:
                 n.digest.add(h, 1, 1 << 60)
 
-    async def establish(self) -> None:
-        """Run the connection-establishment inventory exchange, one
-        link per tick (a dial loop connects peers sequentially, it
-        does not spring a full mesh into existence at once): IBLT
-        catch-up in sync mode (initiated by the lower-index 'outbound'
-        end, converges both directions), the reference big-inv flood —
-        every pair, BOTH directions — otherwise."""
-        for a, b in self.edges:
+    async def establish(self, links_per_tick: int = 1) -> None:
+        """Run the connection-establishment inventory exchange,
+        ``links_per_tick`` links per tick (a dial loop connects peers
+        sequentially, it does not spring a full mesh into existence at
+        once; at lab scale — hundreds of nodes — serial establishment
+        would dominate the run, so links come up in small batches):
+        IBLT catch-up in sync mode (initiated by the lower-index
+        'outbound' end, converges both directions), the reference
+        big-inv flood — every pair, BOTH directions — otherwise."""
+        links_per_tick = max(1, links_per_tick)
+        for i, (a, b) in enumerate(self.edges):
             na, nb = self.nodes[a], self.nodes[b]
             if na.reconciler is not None:
                 await na.reconciler.start_catchup(na.conns[b])
             else:
                 await na.conns[b].announce(list(na.inventory))
                 await nb.conns[a].announce(list(nb.inventory))
-            await self.tick()
+            if (i + 1) % links_per_tick == 0 or \
+                    i + 1 == len(self.edges):
+                await self.tick()
 
     async def drain(self) -> None:
         """Deliver every queued packet (and the packets those spawn)."""
@@ -302,7 +382,8 @@ class Mesh:
     async def tick(self) -> None:
         """One simulated second: flush announcements, run
         reconciliation rounds on their slower cadence, request
-        downloads, settle the wire."""
+        downloads, settle the wire, push federation snapshots on
+        their own cadence."""
         self._tick_no += 1
         reconcile = self._tick_no % self.sync_every == 0
         await self.drain()
@@ -312,6 +393,51 @@ class Mesh:
         for node in self.nodes:
             await node.download_tick()
         await self.drain()
+        if self.aggregator is not None and \
+                self._tick_no % self.federate_every == 0:
+            self.federate_once()
+
+    def federate_once(self) -> None:
+        """Every node pushes one delta snapshot through the real
+        publisher/aggregator path; the wall time spent is accumulated
+        as the federation overhead measurement."""
+        if self.aggregator is None:
+            return
+        t0 = time.perf_counter()
+        for node in self.nodes:
+            if node.publisher is not None:
+                node.publisher.push_once()
+        self.federation_seconds += time.perf_counter() - t0
+
+    def federated_propagation_percentiles(self) -> dict | None:
+        """p50/p90/p99 of origin-to-delivery latency (ticks) from the
+        MERGED per-node histograms — the cross-node view a fleet
+        operator would scrape from the aggregator, not mesh-global
+        bookkeeping."""
+        if self.aggregator is None:
+            return None
+        count = self.aggregator.merged_value("mesh_propagation_seconds")
+        if not count:
+            return None
+        return {"count": int(count),
+                "p50": round(self.aggregator.merged_percentile(
+                    "mesh_propagation_seconds", 0.50), 2),
+                "p90": round(self.aggregator.merged_percentile(
+                    "mesh_propagation_seconds", 0.90), 2),
+                "p99": round(self.aggregator.merged_percentile(
+                    "mesh_propagation_seconds", 0.99), 2)}
+
+    def federated_bytes_per_delivered(self) -> float | None:
+        """Announcement-layer bytes per delivered object from merged
+        per-node counters."""
+        if self.aggregator is None:
+            return None
+        delivered = self.aggregator.merged_value(
+            "mesh_delivered_objects_total")
+        if not delivered:
+            return None
+        return self.aggregator.merged_value(
+            "mesh_announce_bytes_total") / delivered
 
     def converged(self) -> bool:
         union: set[bytes] = set()

@@ -116,12 +116,18 @@ class SyncSession:
         #: the burstiness of this peer's symmetric difference
         self.ewma_diff: float | None = None
         self.ewma_dev = 0.0
-        #: unregistered per-peer breaker, shared metric label (peer
-        #: addresses must not explode cardinality)
+        #: unregistered per-peer breaker; the metric label is the
+        #: hashed peer BUCKET (``sync.reconcile/bNN``) — raw per-peer
+        #: labels blow through MAX_LABEL_SETS at lab scale and collapse
+        #: into the overflow child, one shared label hides which peer
+        #: group is sick; buckets bound cardinality at sites x buckets
+        from ..observability.metrics import peer_bucket_label
         self.breaker = CircuitBreaker(
             "sync:%s:%s" % (conn.host, conn.port),
             threshold=threshold, cooldown=cooldown,
-            label="sync.reconcile", register=False)
+            label=peer_bucket_label(
+                "sync.reconcile", "%s:%s" % (conn.host, conn.port)),
+            register=False)
         self.failures = 0
         self.next_due = 0.0
         #: responder-side round state keyed by round salt — we
@@ -387,7 +393,7 @@ class Reconciler:
         from ..network.messages import (RECONDIFF_DECODE_FAILED,
                                         RECONDIFF_OK, SKETCH_KIND_IBLT,
                                         decode_sketch, encode_recondiff)
-        self._count_rx(payload)
+        self._count_rx(conn, payload)
         s = self.sessions.get(conn)
         if s is None:
             return
@@ -465,7 +471,7 @@ class Reconciler:
         from ..network.messages import (SKETCH_KIND_DIGEST,
                                         SKETCH_KIND_IBLT, decode_sketchreq,
                                         encode_sketch)
-        self._count_rx(payload)
+        self._count_rx(conn, payload)
         s = self.sessions.get(conn)
         if s is None:
             return
@@ -503,7 +509,7 @@ class Reconciler:
 
     async def handle_recondiff(self, conn, payload: bytes) -> None:
         from ..network.messages import (RECONDIFF_OK, decode_recondiff)
-        self._count_rx(payload)
+        self._count_rx(conn, payload)
         s = self.sessions.get(conn)
         if s is None:
             return
@@ -823,23 +829,41 @@ class Reconciler:
         Items the peer demonstrably obtained since the round's
         snapshot froze — it announced them, or an overlapping round
         already pushed them — are skipped, not re-transferred."""
+        send_object = getattr(s.conn, "send_object", None)
         for h, payload in items:
             if h in s.known:
                 continue
             s.mark_known(h)
             LIFECYCLE.record(h, "sync_pushed")
-            await s.conn.send_packet("object", payload)
+            if send_object is not None:
+                # NODE_TRACE peers receive `tobject` (trace-context-
+                # prefixed) so their timeline joins this object's trace
+                await send_object(h, payload)
+            else:
+                await s.conn.send_packet("object", payload)
 
     async def _send(self, conn, command: str, payload: bytes) -> None:
+        # NODE_TRACE peers get the 32-byte trace trailer appended
+        # (clock-skew + cross-node round stitching); simulated/legacy
+        # connections lack the hook and send the classic bytes
+        attach = getattr(conn, "attach_trace", None)
+        if attach is not None:
+            payload = attach(command, payload)
         SKETCH_BYTES.labels(direction="tx").inc(
             len(payload) + FRAME_OVERHEAD)
         self._control_bytes += len(payload) + FRAME_OVERHEAD
         await conn.send_packet(command, payload)
 
-    def _count_rx(self, payload: bytes) -> None:
-        SKETCH_BYTES.labels(direction="rx").inc(
-            len(payload) + FRAME_OVERHEAD)
-        self._control_bytes += len(payload) + FRAME_OVERHEAD
+    def _count_rx(self, conn, payload: bytes) -> None:
+        # the connection strips the 32-byte trace trailer before the
+        # reconciler sees the payload; count it back in so tx and rx
+        # agree on what actually crossed the wire
+        n = len(payload) + FRAME_OVERHEAD
+        if getattr(conn, "trace_negotiated", False):
+            from ..observability.tracing import TRACE_CTX_LEN
+            n += TRACE_CTX_LEN
+        SKETCH_BYTES.labels(direction="rx").inc(n)
+        self._control_bytes += n
 
     def _delivered(self, n: int) -> None:
         if n <= 0:

@@ -86,6 +86,12 @@ def test_daemon_process_lifecycle(tmp_path):
                 break
             time.sleep(0.5)
         assert inbox["inboxMessages"], "self-send never delivered"
+        # the apinotify hook runs as an async subprocess: the inbox can
+        # show the message a beat before the hook's marker write lands
+        deadline = time.time() + 15
+        while time.time() < deadline and \
+                "newMessage" not in marker.read_text():
+            time.sleep(0.3)
         assert "newMessage" in marker.read_text()
 
         # state persisted in the home dir + rotating log live

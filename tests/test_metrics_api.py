@@ -172,3 +172,107 @@ def test_object_timeline_api_command():
             await handler.dispatch("objectTimeline", ["ab"])
 
     asyncio.run(body())
+
+
+def test_federated_status_api_command():
+    """`federatedStatus` serves the aggregator's fleet view (and a
+    clean disabled answer without one)."""
+    import json
+
+    from pybitmessage_tpu.api.commands import CommandHandler
+    from pybitmessage_tpu.observability import (Aggregator,
+                                                FederationPublisher,
+                                                Registry)
+
+    async def body():
+        handler = CommandHandler(SimpleNamespace())
+        assert json.loads(await handler.dispatch(
+            "federatedStatus", []))["enabled"] is False
+
+        agg = Aggregator()
+        reg = Registry()
+        reg.counter("farm_jobs_total", "j").inc(3)
+        FederationPublisher(
+            "child-1", reg, transport=agg.ingest,
+            health=lambda: {"pow": {"status": "ok"}}).push_once()
+        handler = CommandHandler(SimpleNamespace(federation=agg))
+        out = json.loads(await handler.dispatch("federatedStatus", []))
+        assert out["enabled"] is True
+        assert out["fleet"]["nodes"] == 1
+        assert out["nodes"]["child-1"]["verdict"] == "ok"
+
+    asyncio.run(body())
+
+
+def test_federation_push_endpoint_and_federated_metrics():
+    """A child pushes its registry over the REAL HTTP path
+    (http_transport -> POST /federation/push) and the merged fleet
+    view appears on GET /metrics/federated; version mismatches are
+    refused; federation-off serves 404."""
+    import json
+
+    from pybitmessage_tpu.observability import (Aggregator,
+                                                FederationPublisher,
+                                                Registry, http_transport)
+
+    async def body():
+        agg = Aggregator()
+        server = APIServer(SimpleNamespace(federation=agg), port=0,
+                           username="user", password="pass")
+        await server.start()
+        try:
+            auth = base64.b64encode(b"user:pass").decode()
+            # the child end: real publisher over the real transport
+            reg = Registry()
+            reg.counter("farm_jobs_total", "j", ("tenant",)).labels(
+                tenant="acme").inc(5)
+            pub = FederationPublisher(
+                "child-9", reg,
+                transport=http_transport("127.0.0.1",
+                                         server.listen_port,
+                                         username="user",
+                                         password="pass"))
+            ack = await pub.push_once_async()
+            assert ack and ack["ok"]
+
+            status, text = await _get(server.listen_port,
+                                      "/metrics/federated", auth)
+            assert status == 200
+            assert 'farm_jobs_total{tenant="acme"} 5' in text
+            # auth applies to the fleet view too
+            status, _ = await _get(server.listen_port,
+                                   "/metrics/federated")
+            assert status == 401
+
+            # version mismatch: refused with the expected version
+            bad = json.dumps({"v": 999, "node": "x", "seq": 1,
+                              "full": True, "metrics": {}})
+            reader, writer = await asyncio.open_connection(
+                "127.0.0.1", server.listen_port)
+            writer.write((
+                "POST /federation/push HTTP/1.1\r\n"
+                "Authorization: Basic %s\r\n"
+                "Content-Type: application/json\r\n"
+                "Content-Length: %d\r\n\r\n" % (auth, len(bad))
+            ).encode() + bad.encode())
+            await writer.drain()
+            response = await reader.read()
+            writer.close()
+            body_json = json.loads(
+                response.partition(b"\r\n\r\n")[2])
+            assert body_json["ok"] is False
+            assert body_json["reason"] == "version"
+        finally:
+            await server.stop()
+
+        # federation off: both surfaces answer 404, not a crash
+        server = APIServer(SimpleNamespace(), port=0)
+        await server.start()
+        try:
+            status, _ = await _get(server.listen_port,
+                                   "/metrics/federated")
+            assert status == 404
+        finally:
+            await server.stop()
+
+    asyncio.run(body())

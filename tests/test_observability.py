@@ -790,9 +790,14 @@ def test_no_silent_exception_swallows():
             isinstance(stmt.value, ast.Constant)
 
     offenders = []
-    for pkg in ("pow", "network", "sync", "observability", "crypto",
-                "workers"):
-        for path in sorted((root / pkg).glob("*.py")):
+    # tools/ ships operator-facing scripts (bench_compare,
+    # flightrec_merge) that must hold the same bar as the package
+    scan_dirs = [(pkg, root / pkg)
+                 for pkg in ("pow", "network", "sync", "observability",
+                             "crypto", "workers")]
+    scan_dirs.append(("tools", root.parent / "tools"))
+    for pkg, dirpath in scan_dirs:
+        for path in sorted(dirpath.glob("*.py")):
             tree = ast.parse(path.read_text(), filename=str(path))
             for node in ast.walk(tree):
                 if isinstance(node, ast.ExceptHandler) and \
@@ -823,6 +828,8 @@ def test_metric_naming_conventions():
             "pybitmessage_tpu.observability.lifecycle",
             "pybitmessage_tpu.observability.flightrec",
             "pybitmessage_tpu.observability.health",
+            "pybitmessage_tpu.observability.federation",
+            "pybitmessage_tpu.observability.tracing",
             "pybitmessage_tpu.utils.queues",
             "pybitmessage_tpu.workers.cryptopool",
             "pybitmessage_tpu.workers.sender",
@@ -848,3 +855,519 @@ def test_metric_naming_conventions():
             assert fam.name.endswith(_HISTOGRAM_UNITS), fam.name
         elif isinstance(fam, Gauge):
             assert not fam.name.endswith("_total"), fam.name
+
+
+# ---------------------------------------------------------------------------
+# distributed observability plane (ISSUE 9)
+# ---------------------------------------------------------------------------
+
+
+def test_peer_bucket_labeler_stable_and_bounded():
+    """ISSUE 9 satellite: hashed peer buckets are deterministic,
+    bounded by the configured count, and spread distinct peers."""
+    from pybitmessage_tpu.observability import (peer_bucket,
+                                                peer_bucket_label,
+                                                set_peer_buckets)
+    from pybitmessage_tpu.observability.metrics import peer_buckets
+    assert peer_bucket("10.0.0.1:8444") == peer_bucket("10.0.0.1:8444")
+    labels = {peer_bucket("peer-%d" % i) for i in range(1000)}
+    assert len(labels) <= peer_buckets()
+    assert len(labels) > 1
+    assert peer_bucket_label("sync.reconcile", "h:1").startswith(
+        "sync.reconcile/b")
+    old = peer_buckets()
+    try:
+        set_peer_buckets(4)
+        assert len({peer_bucket("p%d" % i) for i in range(100)}) <= 4
+    finally:
+        set_peer_buckets(old)
+
+
+def test_peer_bucket_migrated_breaker_labels():
+    """The per-peer sync/dial breakers carry bucketed labels, not one
+    shared label (per-bucket visibility) and not raw peers (bounded
+    cardinality)."""
+    import re as _re
+
+    from pybitmessage_tpu.sync.reconciler import SyncSession
+
+    class _Conn:
+        host, port = "203.0.113.9", 8444
+
+    s = SyncSession(_Conn())
+    assert _re.fullmatch(r"sync\.reconcile/b\d{2}", s.breaker.label)
+
+
+def test_trace_context_roundtrip_and_rejection():
+    from pybitmessage_tpu.observability import TRACE_CTX_LEN, TraceContext
+    ctx = TraceContext(b"\x42" * 16, 1234, 1000.5)
+    data = ctx.encode()
+    assert len(data) == TRACE_CTX_LEN
+    back = TraceContext.decode(data)
+    assert back.trace_id == b"\x42" * 16
+    assert back.parent_span == 1234
+    assert abs(back.sent_at - 1000.5) < 1e-5
+    with pytest.raises(ValueError):
+        TraceContext.decode(data[:-1])
+    # message-layer split: payload + trailer roundtrip
+    from pybitmessage_tpu.network.messages import (MessageError,
+                                                   append_trace_ctx,
+                                                   split_trace_ctx)
+    framed = append_trace_ctx(b"payload", ctx)
+    payload, parsed = split_trace_ctx(framed)
+    assert payload == b"payload"
+    assert parsed.trace_id == ctx.trace_id
+    with pytest.raises(MessageError):
+        split_trace_ctx(b"short")
+
+
+def test_skew_estimator_bounded_and_converges():
+    from pybitmessage_tpu.observability import SkewEstimator
+    est = SkewEstimator()
+    assert est.offset() == 0.0
+    for _ in range(50):
+        est.observe(1010.0, 1000.0)   # remote runs 10s ahead
+    assert abs(est.offset() - 10.0) < 0.5
+    assert abs(est.normalize(1010.0) - 1000.0) < 0.5
+    # an insane peer clock is clamped, not adopted
+    est2 = SkewEstimator(max_abs=60.0)
+    est2.observe(1e9, 0.0)
+    assert est2.offset() <= 60.0
+    snap = est.snapshot()
+    assert snap["samples"] == 50 and "offsetSeconds" in snap
+
+
+def test_lifecycle_trace_adoption_and_ctx():
+    """adopt() stitches a remote trace onto a hash (first writer
+    wins); trace_ctx_for mints a fresh trace for origin objects and
+    reuses the adopted one for relayed objects."""
+    from pybitmessage_tpu.observability import LifecycleTracer
+    tracer = LifecycleTracer(maxlen=8, stage_histogram=None,
+                             propagation_histogram=None,
+                             update_gauge=False)
+    h = b"\x77" * 32
+    tracer.adopt(h, b"\x01" * 16, parent_span=99)
+    meta = tracer.trace_meta(h)
+    assert meta["trace_id"] == b"\x01" * 16
+    assert meta["parent_span"] == 99
+    # a later duplicate push must not rebind the origin trace
+    tracer.adopt(h, b"\x02" * 16, parent_span=5)
+    assert tracer.trace_meta(h)["trace_id"] == b"\x01" * 16
+    ctx = tracer.trace_ctx_for(h)
+    assert ctx.trace_id == b"\x01" * 16
+    assert ctx.parent_span == meta["span"]  # OUR span becomes their parent
+    # origin object: fresh 16-byte trace id
+    ctx2 = tracer.trace_ctx_for(b"\x88" * 32)
+    assert len(ctx2.trace_id) == 16 and ctx2.trace_id != ctx.trace_id
+    # the meta map is bounded even for hashes that never get timelines
+    for i in range(5 * tracer.maxlen):
+        tracer.trace_ctx_for(i.to_bytes(32, "big"))
+    assert len(tracer._trace_meta) <= 2 * tracer.maxlen
+
+
+# ---------------------------------------------------------------------------
+# federation: snapshot merge goldens (ISSUE 9 tentpole b)
+# ---------------------------------------------------------------------------
+
+
+def _fed():
+    from pybitmessage_tpu.observability import (Aggregator,
+                                                FederationPublisher)
+    return Aggregator, FederationPublisher
+
+
+def test_federation_counter_and_gauge_merge_golden():
+    Aggregator, FederationPublisher = _fed()
+    agg = Aggregator()
+    regs = []
+    for n in (3, 5):
+        reg = Registry()
+        reg.counter("jobs_total", "j", ("lane",)).labels(
+            lane="bulk").inc(n)
+        reg.gauge("depth", "d").set(n)
+        regs.append(reg)
+    for i, reg in enumerate(regs):
+        pub = FederationPublisher("node%d" % i, reg,
+                                  transport=agg.ingest)
+        assert pub.push_once()["ok"]
+    assert agg.merged_value("jobs_total", {"lane": "bulk"}) == 8
+    assert agg.merged_value("depth") == 8
+    text = agg.render()
+    assert 'jobs_total{lane="bulk"} 8' in text
+    assert "depth 8" in text
+
+
+def test_federation_histogram_bucketwise_merge_golden():
+    """Histograms merge bucket-WISE: counts add per bucket, sum/count
+    add, and the merged percentile reads the combined distribution."""
+    Aggregator, FederationPublisher = _fed()
+    agg = Aggregator()
+    for i, values in enumerate(((0.5, 0.5, 0.5), (3.0,))):
+        reg = Registry()
+        h = reg.histogram("lat_seconds", "l", buckets=(1.0, 2.0, 4.0))
+        for v in values:
+            h.observe(v)
+        FederationPublisher("n%d" % i, reg,
+                            transport=agg.ingest).push_once()
+    merged = agg.merged()["lat_seconds"]
+    series = merged["series"][0]
+    assert series["c"] == [3, 0, 1, 0]   # bucket-wise, not concatenated
+    assert series["n"] == 4 and abs(series["s"] - 4.5) < 1e-9
+    assert agg.merged_value("lat_seconds") == 4
+    p50 = agg.merged_percentile("lat_seconds", 0.5)
+    assert 0.0 < p50 <= 1.0
+    text = agg.render()
+    assert 'lat_seconds_bucket{le="1"} 3' in text
+    assert 'lat_seconds_bucket{le="+Inf"} 4' in text
+    assert "lat_seconds_count 4" in text
+
+
+def test_federation_version_mismatch_rejected():
+    Aggregator, _ = _fed()
+    from pybitmessage_tpu.observability.federation import \
+        FEDERATION_VERSION
+    agg = Aggregator()
+    before = REGISTRY.sample("federation_rejected_total",
+                             {"reason": "version"})
+    ack = agg.ingest({"v": FEDERATION_VERSION + 1, "node": "x",
+                      "seq": 1, "full": True, "metrics": {}})
+    assert ack["ok"] is False and ack["reason"] == "version"
+    assert REGISTRY.sample("federation_rejected_total",
+                           {"reason": "version"}) == before + 1
+    # malformed pushes are refused without raising
+    assert agg.ingest(None)["ok"] is False
+    assert agg.ingest({"v": FEDERATION_VERSION})["ok"] is False
+    assert agg.status()["fleet"]["nodes"] == 0
+
+
+def test_federation_delta_encoding_and_resync():
+    """Second push carries ONLY changed series, yet the merged view
+    stays complete; a delta for an unknown node forces a full
+    resync."""
+    Aggregator, FederationPublisher = _fed()
+    agg = Aggregator()
+    reg = Registry()
+    c1 = reg.counter("a_total", "a")
+    c2 = reg.counter("b_total", "b")
+    c1.inc(1)
+    c2.inc(7)
+    pub = FederationPublisher("n", reg, transport=agg.ingest)
+    push1, _ = pub.build_push()
+    assert push1["full"] and set(push1["metrics"]) == {"a_total",
+                                                       "b_total"}
+    assert agg.ingest(push1)["ok"]
+    pub._settle({"ok": True}, __import__(
+        "pybitmessage_tpu.observability.federation",
+        fromlist=["mergeable_snapshot"]).mergeable_snapshot(reg))
+    c1.inc(2)  # only a_total changes
+    push2, _ = pub.build_push()
+    assert not push2["full"]
+    assert set(push2["metrics"]) == {"a_total"}
+    assert agg.ingest(push2)["ok"]
+    assert agg.merged_value("a_total") == 3
+    assert agg.merged_value("b_total") == 7   # unchanged series kept
+    # a delta reaching an aggregator that never saw the node: resync
+    agg2 = Aggregator()
+    pub2 = FederationPublisher("n", reg, transport=agg2.ingest)
+    pub2._acked = {}  # pretend something was acked -> builds a delta
+    pub2.seq = 5
+    ack = agg2.ingest(pub2.build_push()[0])
+    assert ack["ok"] is False and ack["reason"] == "resync"
+    # the publisher reacts by going full on the next push
+    pub2._settle(ack, {})
+    push_full, _ = pub2.build_push()
+    assert push_full["full"]
+    assert agg2.ingest(push_full)["ok"]
+
+
+def test_federation_sequence_gap_forces_resync():
+    Aggregator, FederationPublisher = _fed()
+    agg = Aggregator()
+    reg = Registry()
+    reg.counter("g_total", "g").inc()
+    pub = FederationPublisher("n", reg, transport=agg.ingest)
+    assert pub.push_once()["ok"]
+    pub.seq += 3   # simulate lost pushes
+    ack = pub.push_once()
+    assert ack["ok"] is False and ack["reason"] == "resync"
+    # next push self-heals as full
+    assert pub.push_once()["ok"]
+    assert agg.merged_value("g_total") == 1
+
+
+def test_federation_status_health_verdicts():
+    Aggregator, FederationPublisher = _fed()
+    agg = Aggregator(expiry=0.5, clock=lambda: 100.0)
+    reg = Registry()
+    pub = FederationPublisher(
+        "sick", reg, transport=agg.ingest,
+        health=lambda: {"loop": {"status": "degraded", "lagP99Ms": 80}},
+        skew=lambda: 1.5)
+    pub.push_once()
+    FederationPublisher(
+        "fine", reg, transport=agg.ingest,
+        health=lambda: {"loop": {"status": "ok"}}).push_once()
+    status = agg.status()
+    assert status["nodes"]["sick"]["verdict"] == "degraded"
+    assert status["nodes"]["sick"]["skewSeconds"] == 1.5
+    assert status["nodes"]["fine"]["verdict"] == "ok"
+    assert status["fleet"] == {"nodes": 2, "degraded": 1, "stale": 0,
+                               "ok": 1}
+    # stale: no push within expiry
+    agg.clock = lambda: 10_000.0
+    assert agg.status()["nodes"]["fine"]["verdict"] == "stale"
+
+
+def test_federated_mesh_runs_real_federation_path():
+    """ISSUE 9 tentpole c: the simulated mesh's propagation and byte
+    figures come from MERGED per-node snapshots pushed through the
+    real publisher/aggregator machinery."""
+    import asyncio
+    import os
+
+    from pybitmessage_tpu.sync.mesh import Mesh
+
+    async def run():
+        mesh = Mesh(6, sync=True, fanout=1, federation=True,
+                    federate_every=2)
+        mesh.seed(0, [b"\x05" * 32])
+        await mesh.establish()
+        for i in range(8):
+            mesh.inject(i % 6, os.urandom(32))
+            await mesh.tick()
+        await mesh.run_until_converged()
+        mesh.federate_once()
+        return mesh
+
+    mesh = asyncio.run(run())
+    prop = mesh.federated_propagation_percentiles()
+    assert prop is not None and prop["count"] >= 8
+    assert prop["p50"] <= prop["p99"]
+    bpd = mesh.federated_bytes_per_delivered()
+    assert bpd is not None and bpd > 0
+    assert mesh.aggregator.status()["fleet"]["nodes"] == 6
+    assert mesh.federation_seconds > 0
+
+
+# ---------------------------------------------------------------------------
+# flight recorder merge (ISSUE 9 satellite)
+# ---------------------------------------------------------------------------
+
+
+def _flightrec_merge():
+    import importlib.util
+    import pathlib
+    path = (pathlib.Path(__file__).parent.parent / "tools"
+            / "flightrec_merge.py")
+    spec = importlib.util.spec_from_file_location("flightrec_merge", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_flightrec_dump_records_skew_and_node():
+    from pybitmessage_tpu.observability import FlightRecorder
+    rec = FlightRecorder(maxlen=8)
+    rec.node_id = "deadbeef"
+    rec.skew_provider = lambda: 2.5
+    rec.record("breaker", name="x")
+    out = rec.dump_record("api")
+    assert out["node"] == "deadbeef"
+    assert out["skew"] == 2.5
+    assert out["events"][-1]["kind"] == "breaker"
+    # a broken provider degrades to 0.0, never fails the dump
+    rec.skew_provider = lambda: 1 / 0
+    assert rec.dump_record("api")["skew"] == 0.0
+
+
+def test_flightrec_merge_normalizes_skew():
+    """Two nodes' dumps with disagreeing clocks merge into one
+    causally-ordered timeline after skew normalization."""
+    fm = _flightrec_merge()
+    # nodeA's clock runs 5s ahead: its raw t=105 happened at ref t=100
+    dump_a = {"node": "A", "skew": 5.0, "events": [
+        {"kind": "breaker", "t": 105.0, "seq": 1},
+        {"kind": "chaos", "t": 107.0, "seq": 2}]}
+    dump_b = {"node": "B", "skew": 0.0, "events": [
+        {"kind": "stall", "t": 101.0, "seq": 1}]}
+    merged = fm.merge([dump_a, dump_b])
+    assert [e["kind"] for e in merged] == ["breaker", "stall", "chaos"]
+    assert merged[0]["t_norm"] == 100.0
+    # raw-t order would have been wrong: stall, breaker, chaos
+    text = fm.render_text(merged)
+    assert "breaker" in text.splitlines()[0]
+
+
+def test_flightrec_merge_parses_log_lines_and_json():
+    import json as _json
+    fm = _flightrec_merge()
+    dumps = fm.parse_dumps(_json.dumps(
+        {"node": "n1", "skew": 1.0,
+         "events": [{"kind": "x", "t": 1.0, "seq": 1}]}))
+    assert dumps[0]["node"] == "n1"
+    log = ("2026-08-03 INFO noise\n"
+           "2026-08-03 WARNING flightrec_dump trigger=stall events=1 "
+           '{"node": "n2", "skew": 0.0, "events": '
+           '[{"kind": "stall", "t": 2.0, "seq": 1}]}\n')
+    dumps = fm.parse_dumps(log, source="debug.log")
+    assert dumps[0]["node"] == "n2"
+    assert dumps[0]["events"][0]["kind"] == "stall"
+    # legacy bare-array dumps: skew 0, node falls back to the source
+    dumps = fm.parse_dumps('[{"kind": "y", "t": 3.0, "seq": 1}]',
+                           source="old.json")
+    assert dumps[0]["skew"] == 0.0 and dumps[0]["node"] == "old.json"
+    with pytest.raises(ValueError):
+        fm.parse_dumps("no dumps here", source="empty.log")
+
+
+# ---------------------------------------------------------------------------
+# wire trace context over a real two-node TCP pair (ISSUE 9 tentpole a)
+# ---------------------------------------------------------------------------
+
+
+def _trace_node(trace: bool = True, interval: float = 0.2):
+    """Two-node-pattern node builder (extends test_sync.py's
+    _sync_node) with the NODE_TRACE service bit toggleable."""
+    from pybitmessage_tpu.models.constants import NODE_SYNC, NODE_TRACE
+    from pybitmessage_tpu.network.dandelion import Dandelion
+    from pybitmessage_tpu.network.pool import ConnectionPool, NodeContext
+    from pybitmessage_tpu.storage import Database, Inventory, KnownNodes
+    from pybitmessage_tpu.sync import InventoryDigest, Reconciler
+
+    inv = Inventory(Database(":memory:"))
+    ctx = NodeContext(inventory=inv, knownnodes=KnownNodes(),
+                      dandelion=Dandelion(enabled=False), port=0,
+                      allow_private_peers=True, announce_buckets=1,
+                      pow_ntpb=1, pow_extra=1)
+    pool = ConnectionPool(ctx, listen_host="127.0.0.1")
+    digest = InventoryDigest()
+    inv.attach_digest(digest)
+    pool.reconciler = Reconciler(pool, digest=digest, interval=interval)
+    ctx.services |= NODE_SYNC
+    if trace:
+        ctx.services |= NODE_TRACE
+    return ctx, pool
+
+
+def _traced_object(body: bytes, ttl: int = 3600):
+    from pybitmessage_tpu.models.objects import serialize_object
+    from pybitmessage_tpu.models.pow_math import (pow_initial_hash,
+                                                  pow_target)
+    from pybitmessage_tpu.pow import python_solve
+
+    expires = int(time.time()) + ttl
+    obj = serialize_object(expires, 2, 1, 1, body)
+    target = pow_target(len(obj), ttl, 1, 1, clamp=False)
+    nonce, _ = python_solve(pow_initial_hash(obj[8:]), target)
+    return nonce.to_bytes(8, "big") + obj[8:], expires
+
+
+async def _await_until(predicate, timeout=25.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if predicate():
+            return True
+        await asyncio.sleep(0.05)
+    return False
+
+
+def _spy_object_commands(conn):
+    """Instance-level capture of object/tobject frames reaching one
+    connection (the read loop resolves handlers via getattr, so an
+    instance attribute shadows the class method)."""
+    seen = {"tobject": [], "object": []}
+    orig_tobj = conn.cmd_tobject
+    orig_obj = conn.cmd_object
+
+    async def spy_tobj(payload):
+        seen["tobject"].append(payload)
+        await orig_tobj(payload)
+
+    async def spy_obj(payload):
+        seen["object"].append(payload)
+        await orig_obj(payload)
+
+    conn.cmd_tobject = spy_tobj
+    conn.cmd_object = spy_obj
+    return seen
+
+
+@pytest.mark.asyncio
+async def test_trace_ctx_roundtrips_two_real_tcp_nodes():
+    """Negotiation + propagation end to end: both ends advertise
+    NODE_TRACE, so an object pushed A->B travels as `tobject` carrying
+    the trace context, B's skew estimator samples it, and B's
+    timeline adopts A's trace id."""
+    from pybitmessage_tpu.observability import LIFECYCLE, TraceContext
+    from pybitmessage_tpu.observability.tracing import TRACE_CTX_LEN
+    from pybitmessage_tpu.storage import Peer
+    from pybitmessage_tpu.utils.hashes import inventory_hash
+
+    ctx_a, pool_a = _trace_node()
+    ctx_b, pool_b = _trace_node()
+    await pool_a.start()
+    await pool_b.start(listen=False)
+    try:
+        conn = await pool_b.connect_to(
+            Peer("127.0.0.1", pool_a.listen_port))
+        assert conn is not None
+        assert await _await_until(lambda: conn.fully_established)
+        assert conn.trace_negotiated
+        seen = _spy_object_commands(conn)
+
+        payload, expires = _traced_object(b"traced push")
+        h = inventory_hash(payload)
+        ctx_a.inventory.add(h, 2, 1, payload, expires)
+        pool_a.announce_object(h, local=False)
+        assert await _await_until(lambda: h in ctx_b.inventory), \
+            "object did not propagate"
+        # the push crossed as tobject (trace-context-prefixed) ...
+        assert seen["tobject"], "no tobject frame reached B"
+        wire_ctx = TraceContext.decode(seen["tobject"][0][:TRACE_CTX_LEN])
+        # ... carrying A's trace id for this object, which B adopted
+        meta = LIFECYCLE.trace_meta(h)
+        assert meta is not None
+        assert wire_ctx.trace_id == meta["trace_id"]
+        assert wire_ctx.parent_span == meta["span"]
+        # skew estimator sampled the context's send timestamp;
+        # loopback clocks agree, so the bounded estimate is tiny
+        assert conn.skew.samples >= 1
+        assert abs(conn.skew.offset()) < 5.0
+        LIFECYCLE.discard(h)
+    finally:
+        await pool_b.stop()
+        await pool_a.stop()
+
+
+@pytest.mark.asyncio
+async def test_trace_ctx_silent_for_legacy_peer():
+    """Degradation: against a peer without NODE_TRACE the wire is
+    byte-identical to the classic protocol — plain `object` frames,
+    no trailers on sync rounds, zero trace contexts parsed."""
+    from pybitmessage_tpu.storage import Peer
+    from pybitmessage_tpu.utils.hashes import inventory_hash
+
+    ctx_a, pool_a = _trace_node(trace=True)
+    ctx_b, pool_b = _trace_node(trace=False)   # legacy end
+    await pool_a.start()
+    await pool_b.start(listen=False)
+    try:
+        conn = await pool_b.connect_to(
+            Peer("127.0.0.1", pool_a.listen_port))
+        assert await _await_until(lambda: conn.fully_established)
+        assert not conn.trace_negotiated
+        seen = _spy_object_commands(conn)
+
+        payload, expires = _traced_object(b"legacy push")
+        h = inventory_hash(payload)
+        ctx_a.inventory.add(h, 2, 1, payload, expires)
+        pool_a.announce_object(h, local=False)
+        assert await _await_until(lambda: h in ctx_b.inventory), \
+            "object did not propagate to the legacy peer"
+        # classic frames only, the payload bit-exact, nothing sampled
+        assert not seen["tobject"], "tobject sent to a legacy peer"
+        assert payload in seen["object"]
+        assert conn.skew.samples == 0
+    finally:
+        await pool_b.stop()
+        await pool_a.stop()

@@ -43,6 +43,10 @@ DEFAULT_BASELINE = os.path.join(REPO_ROOT, "bench_baseline_smoke.json")
 #:                  the baseline value is informational only — used
 #:                  for ratios whose run-to-run variance dwarfs any
 #:                  relative band but whose acceptance bar is fixed)
+#: kind "atmost"  — regression when current > tol (absolute ceiling;
+#:                  the baseline is informational — used for overhead
+#:                  fractions whose acceptance bar is fixed, like the
+#:                  federation <2% budget)
 GUARDS: list[tuple[str, str, float]] = [
     # headline device rate (wall-clock: generous band)
     ("value", "higher", 0.60),
@@ -79,6 +83,13 @@ GUARDS: list[tuple[str, str, float]] = [
     ("configs.sync_storm.zero_objects_lost", "equal", 0.0),
     # propagation latency (ticks) may not grow past its band
     ("configs.sync_storm.propagation_ticks.reconciliation.p99",
+     "lower", 1.00),
+    # distributed observability plane (ISSUE 9): the federated mesh
+    # must keep measuring (merged propagation observed, zero loss)
+    # and the federation path must stay under its 2% overhead budget
+    ("configs.sync_storm.federation.zero_objects_lost", "equal", 0.0),
+    ("configs.sync_storm.federation.overhead_frac", "atmost", 0.02),
+    ("configs.sync_storm.federation.propagation_ticks.p99",
      "lower", 1.00),
 ]
 
@@ -132,17 +143,20 @@ def compare(baseline: dict, current: dict,
             else:
                 notes.append("OK    %s: %r" % (path, cur))
             continue
-        if kind == "atleast":
+        if kind in ("atleast", "atmost"):
             try:
                 cur_f = float(cur)
             except (TypeError, ValueError):
                 failures.append("FAIL  %s: non-numeric %r" % (path, cur))
                 continue
-            ok = cur_f >= tol
+            if kind == "atleast":
+                ok, rel, word = cur_f >= tol, ">=", "floor"
+            else:
+                ok, rel, word = cur_f <= tol, "<=", "ceiling"
             (notes if ok else failures).append(
-                "%s %s: %.4g >= %.4g (absolute floor; baseline %.4g)"
-                % ("OK   " if ok else "FAIL ", path, cur_f, tol,
-                   float(base)))
+                "%s %s: %.4g %s %.4g (absolute %s; baseline %.4g)"
+                % ("OK   " if ok else "FAIL ", path, cur_f, rel, tol,
+                   word, float(base)))
             continue
         try:
             base_f, cur_f = float(base), float(cur)

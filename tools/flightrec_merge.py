@@ -1,0 +1,137 @@
+#!/usr/bin/env python3
+"""Merge flight-recorder dumps from many nodes into ONE timeline.
+
+Each node's :class:`~pybitmessage_tpu.observability.flightrec.
+FlightRecorder` dump carries raw LOCAL wall-clock timestamps plus the
+node's federation clock-skew estimate (remote-minus-local seconds, fed
+by the wire-trace skew estimators).  Interleaving several nodes'
+dumps by raw ``t`` therefore re-orders causally-related events
+whenever clocks disagree; this tool normalizes every event onto one
+reference clock (``t_norm = t - skew``) before merging:
+
+    python tools/flightrec_merge.py dumpA.json dumpB.json
+    python tools/flightrec_merge.py --json node1/debug.log node2/debug.log
+
+Accepted inputs, auto-detected per file:
+
+- a dump dict ``{"node": ..., "skew": ..., "events": [...]}`` (the
+  ``dumpFlightRecorder`` API output / ``dump_record()`` shape);
+- a bare JSON event array (legacy dumps; skew 0);
+- a log file: every ``flightrec_dump ... {...}`` line it contains is
+  parsed (so ``debug.log`` from a crashed node works directly).
+
+Output: the combined timeline, oldest first, each event annotated
+with its source ``node`` and skew-normalized ``t_norm`` — as an
+aligned text table, or one JSON document with ``--json``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def parse_dumps(text: str, *, source: str = "?") -> list[dict]:
+    """Every dump found in ``text`` as ``{"node", "skew", "events"}``
+    dicts.  Raises ValueError when the file contains none."""
+    text = text.strip()
+    # whole-file JSON first (API output / dump_record / bare array)
+    try:
+        doc = json.loads(text)
+    except json.JSONDecodeError:
+        doc = None
+    if isinstance(doc, dict) and isinstance(doc.get("events"), list):
+        return [_norm_dump(doc, source)]
+    if isinstance(doc, list):
+        return [_norm_dump({"events": doc}, source)]
+    # else: scan for flightrec_dump log lines (one JSON blob per line)
+    dumps = []
+    for line in text.splitlines():
+        marker = line.find("flightrec_dump")
+        if marker == -1:
+            continue
+        brace = line.find("{", marker)
+        bracket = line.find("[", marker)
+        starts = [i for i in (brace, bracket) if i != -1]
+        if not starts:
+            continue
+        try:
+            doc = json.loads(line[min(starts):])
+        except json.JSONDecodeError:
+            continue
+        if isinstance(doc, dict) and isinstance(doc.get("events"), list):
+            dumps.append(_norm_dump(doc, source))
+        elif isinstance(doc, list):
+            dumps.append(_norm_dump({"events": doc}, source))
+    if not dumps:
+        raise ValueError("%s: no flight-recorder dump found" % source)
+    return dumps
+
+
+def _norm_dump(doc: dict, source: str) -> dict:
+    return {"node": str(doc.get("node") or source),
+            "skew": float(doc.get("skew") or 0.0),
+            "events": [e for e in doc["events"] if isinstance(e, dict)]}
+
+
+def merge(dumps: list[dict]) -> list[dict]:
+    """One combined timeline: every event annotated with its node and
+    its skew-normalized timestamp, sorted oldest first (ties broken by
+    per-node seq so one node's events never reorder)."""
+    out = []
+    for dump in dumps:
+        skew = dump["skew"]
+        for event in dump["events"]:
+            e = dict(event)
+            e["node"] = dump["node"]
+            t = float(e.get("t") or 0.0)
+            e["t_norm"] = round(t - skew, 4)
+            out.append(e)
+    out.sort(key=lambda e: (e["t_norm"], e["node"],
+                            e.get("seq", 0)))
+    return out
+
+
+def render_text(events: list[dict]) -> str:
+    """Aligned human view: t_norm, node, kind, then the free fields."""
+    lines = []
+    t0 = events[0]["t_norm"] if events else 0.0
+    for e in events:
+        rest = {k: v for k, v in e.items()
+                if k not in ("t", "t_norm", "seq", "node", "kind")}
+        lines.append("%10.4f  %-12s %-14s %s" % (
+            e["t_norm"] - t0, e["node"][:12], e.get("kind", "?"),
+            " ".join("%s=%s" % kv for kv in sorted(rest.items()))))
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("files", nargs="+",
+                    help="dump JSON files or log files holding "
+                         "flightrec_dump lines")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit the merged timeline as JSON instead of "
+                         "the text table")
+    args = ap.parse_args(argv)
+
+    dumps = []
+    for path in args.files:
+        try:
+            with open(path) as f:
+                dumps.extend(parse_dumps(f.read(), source=path))
+        except (OSError, ValueError) as exc:
+            sys.stderr.write("flightrec_merge: %s\n" % exc)
+            return 2
+    events = merge(dumps)
+    if args.as_json:
+        print(json.dumps({"nodes": sorted({d["node"] for d in dumps}),
+                          "events": events}, indent=2, default=repr))
+    else:
+        print(render_text(events))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
