@@ -2,7 +2,7 @@
 # build + tox targets).  The C++ solver is also auto-built at runtime by
 # pybitmessage_tpu/pow/native.py when missing or stale.
 
-.PHONY: all native test bench bench-smoke chaos perfguard clean
+.PHONY: all native test bench bench-smoke chaos perfguard lint clean
 
 all: native
 
@@ -12,6 +12,16 @@ native:
 
 test: native
 	python -m pytest tests/ -q
+
+# bmlint static-analysis gate (docs/static_analysis.md): AST checkers
+# proving the standing conventions — crypto/SQL off the event loop,
+# no RMW across awaits without a lock, no silent broad excepts,
+# REGISTRY-only metrics with bounded labels, full chaos-site coverage.
+# New findings and stale baseline entries both fail; the committed
+# baseline (tools/bmlint/baseline.json) only ever shrinks.  Also runs
+# inside tier-1 via tests/test_bmlint.py.
+lint:
+	python -m tools.bmlint
 
 bench: native
 	python bench.py

@@ -208,8 +208,9 @@ class APIServer:
             try:
                 writer.close()
                 await writer.wait_closed()
-            except Exception:
-                pass
+            except Exception as exc:
+                # routine for an already-dead client, but never silent
+                logger.debug("API connection close failed: %r", exc)
 
     async def _dispatch(self, req: dict) -> dict:
         method = req.get("method", "")

@@ -63,6 +63,8 @@ class NativeSecp:
                            capture_output=True, timeout=120)
             return True
         except Exception as exc:
+            from ..resilience.policy import ERRORS
+            ERRORS.labels(site="crypto.native_build").inc()
             logger.warning("could not build native secp256k1: %r", exc)
             return False
 

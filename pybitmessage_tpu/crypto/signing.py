@@ -113,7 +113,9 @@ def _verify_one(data: bytes, signature: bytes, pubkey: bytes,
                     else hashes.SHA1)()
             key.verify(signature, data, ec.ECDSA(algo))
             return True
-        except Exception:
+        # a malformed/forged signature IS the False result — not an
+        # error path, so it is not counted into resilience_errors_total
+        except Exception:  # bmlint: allow(except-discipline)
             return False
     from . import fallback
     try:

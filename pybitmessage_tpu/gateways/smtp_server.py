@@ -131,8 +131,10 @@ class SMTPGateway:
         finally:
             try:
                 writer.close()
-            except Exception:
-                pass
+            except Exception as exc:
+                # routine for a client that hung up mid-session, but
+                # never silent (bmlint silent-swallow)
+                logger.debug("SMTP connection close failed: %r", exc)
 
     async def _auth(self, arg: str, send, reader) -> bool:
         """AUTH PLAIN, inline or challenge form (RFC 4616)."""

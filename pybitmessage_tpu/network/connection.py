@@ -157,6 +157,8 @@ class BMConnection:
         except asyncio.CancelledError:
             pass
         except Exception:
+            from ..resilience.policy import ERRORS
+            ERRORS.labels(site="net.parse").inc()
             logger.exception("connection %s:%s parser error",
                              self.host, self.port)
         finally:

@@ -96,6 +96,8 @@ class UDPDiscovery(asyncio.DatagramProtocol):
                 return  # only addr is enabled on UDP (udp.py:65-78)
             self._handle_addr(payload, src_host)
         except Exception:
+            from ..resilience.policy import ERRORS
+            ERRORS.labels(site="net.udp_datagram").inc()
             logger.debug("malformed UDP datagram from %s", src_host,
                          exc_info=True)
 
@@ -123,6 +125,8 @@ class UDPDiscovery(asyncio.DatagramProtocol):
             try:
                 self.announce()
             except Exception:
+                from ..resilience.policy import ERRORS
+                ERRORS.labels(site="net.udp_announce").inc()
                 logger.exception("UDP announce failed")
             await asyncio.sleep(self.announce_interval)
 

@@ -248,7 +248,14 @@ class FederationPublisher:
         if not isinstance(ack, dict) or not ack.get("ok"):
             reason = (ack or {}).get("reason", "error") \
                 if isinstance(ack, dict) else "error"
-            PUSHES.labels(result=str(reason)).inc()
+            # the reason string comes from the REMOTE aggregator — clamp
+            # to the known ack vocabulary (Aggregator.ingest) so a
+            # buggy/hostile peer cannot mint unbounded label values
+            # (bmlint metric-labels)
+            if reason not in ("version", "resync", "malformed",
+                              "capacity", "buckets", "error"):
+                reason = "other"
+            PUSHES.labels(result=reason).inc()
             # resync: the aggregator lost (or never had) our state —
             # the next push must be full or its merged view would miss
             # every series that happens not to change again

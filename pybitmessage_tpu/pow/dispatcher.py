@@ -195,7 +195,9 @@ class PowDispatcher:
         key = (ndev, obj_size)
         if key not in self._meshes:
             from ..parallel import make_mesh
-            MESH_COMPILES.labels(shape="%dx%d" % key).inc()
+            # shape values are bounded by the pod topology (device
+            # count x slab obj_size), not by traffic
+            MESH_COMPILES.labels(shape="%dx%d" % key).inc()  # bmlint: allow(metric-labels)
             if obj_size == 1:
                 self._meshes[key] = make_mesh(ndev)
             else:

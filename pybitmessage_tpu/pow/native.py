@@ -36,6 +36,8 @@ class NativeSolver:
                            capture_output=True, timeout=120)
             return True
         except Exception as exc:
+            from ..resilience.policy import ERRORS
+            ERRORS.labels(site="pow.native_build").inc()
             logger.warning("could not build native solver: %r", exc)
             return False
 

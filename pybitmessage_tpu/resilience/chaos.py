@@ -14,6 +14,8 @@ Site catalog (docs/resilience.md keeps the authoritative table):
 ``net.dial``           an outbound dial (``ConnectionPool.connect_to``)
 ``net.send``           a framed packet send (``BMConnection.send_packet``)
 ``api.dispatch``       an RPC command dispatch (API server)
+``sync.sketch_decode`` sketch subtract/peel (reconciler gossip/catch-up)
+``crypto.native``      a native batch-crypto drain (``crypto/batch.py``)
 ==================  =====================================================
 
 Arming, one of:

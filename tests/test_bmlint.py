@@ -1,0 +1,629 @@
+"""bmlint test suite (ISSUE 10): fixture-snippet suites per checker
+(true positive / true negative / suppression), baseline round-trip,
+JSON output golden, and the self-test proving the gate bites — plus
+the tier-1 repo gate itself: the committed tree must lint clean
+against the committed baseline.
+"""
+
+import functools
+import json
+import pathlib
+import sys
+
+import pytest
+
+REPO_ROOT = pathlib.Path(__file__).resolve().parent.parent
+if str(REPO_ROOT) not in sys.path:
+    sys.path.insert(0, str(REPO_ROOT))
+
+from tools.bmlint import (compare_baseline, build_baseline,  # noqa: E402
+                          load_baseline, run_checkers)
+from tools.bmlint.__main__ import (DEFAULT_BASELINE,  # noqa: E402
+                                   DEFAULT_ROOTS, collect_files, main)
+
+#: default fixture location — a critical dir, so severity is "error"
+POW = "pybitmessage_tpu/pow/fixture.py"
+CORE = "pybitmessage_tpu/core/fixture.py"
+
+
+def lint(src, path=POW, rules=None, extra_files=()):
+    res = run_checkers(list(extra_files) + [(path, src)])
+    found = res.findings
+    if rules is not None:
+        found = [f for f in found if f.rule in rules]
+    return found
+
+
+# ---------------------------------------------------------------------------
+# loop-blocking
+# ---------------------------------------------------------------------------
+
+
+def test_blocking_true_positives():
+    src = (
+        "import time\n"
+        "import subprocess\n"
+        "async def handler(self):\n"
+        "    time.sleep(1)\n"
+        "    subprocess.run(['ls'])\n"
+        "    open('/tmp/x')\n"
+        "    self.db.execute('DELETE FROM inbox')\n"
+    )
+    found = lint(src, rules=("loop-blocking",))
+    assert len(found) == 4
+    assert all(f.severity == "error" for f in found)
+    assert "asyncio.sleep" in found[0].message
+
+
+def test_blocking_crypto_entry_points():
+    src = (
+        "from ..crypto import sign, encrypt\n"
+        "async def send(self, data, key):\n"
+        "    sig = sign(data, key)\n"
+        "    return encrypt(data, key)\n"
+    )
+    assert len(lint(src, rules=("loop-blocking",))) == 2
+
+
+def test_blocking_crypto_submodule_import_not_bypassed():
+    """``from ..crypto.signing import sign`` must hit the same rule —
+    the submodule spelling is not an evasion of the gate."""
+    src = (
+        "from ..crypto.signing import sign\n"
+        "from pybitmessage_tpu.crypto.ecies import encrypt\n"
+        "async def send(self, data, key):\n"
+        "    sig = sign(data, key)\n"
+        "    return encrypt(data, key)\n"
+    )
+    assert len(lint(src, rules=("loop-blocking",))) == 2
+
+
+def test_blocking_true_negatives():
+    src = (
+        "import time\n"
+        "import asyncio\n"
+        "def sync_path(self):\n"
+        "    time.sleep(1)\n"          # sync function: fine
+        "async def ok(self):\n"
+        "    await asyncio.sleep(1)\n"
+        "    loop = asyncio.get_running_loop()\n"
+        "    def work():\n"
+        "        time.sleep(1)\n"      # executor payload: fine
+        "    await loop.run_in_executor(None, work)\n"
+        "    await loop.run_in_executor(None, lambda: open('/t'))\n"
+    )
+    assert lint(src, rules=("loop-blocking",)) == []
+
+
+def test_blocking_suppression_comment():
+    src = (
+        "import time\n"
+        "async def f(self):\n"
+        "    time.sleep(0.001)  # bmlint: allow(loop-blocking)\n"
+    )
+    res = run_checkers([(POW, src)])
+    assert [f.rule for f in res.findings] == []
+    assert [f.rule for f in res.suppressed] == ["loop-blocking"]
+
+
+# ---------------------------------------------------------------------------
+# await-race / unawaited-coro / untracked-task
+# ---------------------------------------------------------------------------
+
+
+def test_await_race_alias_rmw():
+    src = (
+        "async def bump(self):\n"
+        "    cur = self.counter\n"
+        "    await self.flush()\n"
+        "    self.counter = cur + 1\n"
+    )
+    found = lint(src, rules=("await-race",))
+    assert len(found) == 1
+    assert "self.counter" in found[0].message
+
+
+def test_await_race_intra_statement():
+    src = (
+        "async def bump(self):\n"
+        "    self.total += await self.fetch()\n"
+        "async def direct(self):\n"
+        "    self.total = self.total + await self.fetch()\n"
+    )
+    assert len(lint(src, rules=("await-race",))) == 2
+
+
+def test_await_race_true_negatives():
+    src = (
+        "async def ok(self):\n"
+        "    self.n += 1\n"            # atomic on the loop
+        "    await self.flush()\n"
+        "    self.n -= 1\n"            # atomic again — not a race
+        "async def loaded_after(self):\n"
+        "    await self.flush()\n"
+        "    self.n = self.n + 1\n"    # read after the await: atomic
+    )
+    assert lint(src, rules=("await-race",)) == []
+
+
+def test_await_race_lock_held_is_clean():
+    src = (
+        "async def bump(self):\n"
+        "    async with self._lock:\n"
+        "        cur = self.counter\n"
+        "        await self.flush()\n"
+        "        self.counter = cur + 1\n"
+    )
+    assert lint(src, rules=("await-race",)) == []
+
+
+def test_unawaited_coro_and_untracked_task():
+    src = (
+        "import asyncio\n"
+        "async def work():\n"
+        "    pass\n"
+        "class Node:\n"
+        "    async def start(self):\n"
+        "        pass\n"
+        "    def kick(self):\n"
+        "        self.start()\n"           # coroutine never scheduled
+        "        asyncio.create_task(work())\n"  # dropped task handle
+        "def top():\n"
+        "    work()\n"                     # bare-name coroutine call
+    )
+    rules = [f.rule for f in lint(
+        src, rules=("unawaited-coro", "untracked-task"))]
+    assert rules.count("unawaited-coro") == 2
+    assert rules.count("untracked-task") == 1
+
+
+def test_unawaited_coro_foreign_receiver_not_flagged():
+    """``conn.start()`` says nothing about conn's class — the old
+    false-positive class this checker must not regress into."""
+    src = (
+        "class Pool:\n"
+        "    async def start(self):\n"
+        "        pass\n"
+        "    def accept(self, conn):\n"
+        "        conn.start()\n"
+        "        t = __import__('asyncio').get_event_loop()\n"
+    )
+    assert lint(src, rules=("unawaited-coro",)) == []
+
+
+# ---------------------------------------------------------------------------
+# silent-swallow (severity tiers)
+# ---------------------------------------------------------------------------
+
+
+def test_swallow_positive_and_severity_tiers():
+    src = (
+        "def f():\n"
+        "    try:\n"
+        "        work()\n"
+        "    except Exception:\n"
+        "        pass\n"
+    )
+    pow_found = lint(src, path=POW, rules=("silent-swallow",))
+    core_found = lint(src, path=CORE, rules=("silent-swallow",))
+    assert pow_found[0].severity == "error"
+    assert core_found[0].severity == "warning"
+
+
+def test_swallow_negative_logged_or_narrow():
+    src = (
+        "def f():\n"
+        "    try:\n"
+        "        work()\n"
+        "    except ValueError:\n"      # narrow: fine
+        "        pass\n"
+        "    try:\n"
+        "        work()\n"
+        "    except Exception:\n"
+        "        logger.exception('boom')\n"   # visible: fine
+    )
+    assert lint(src, rules=("silent-swallow",)) == []
+
+
+# ---------------------------------------------------------------------------
+# metrics discipline
+# ---------------------------------------------------------------------------
+
+
+def test_metric_naming_violations():
+    src = (
+        "C1 = REGISTRY.counter('hits', 'missing suffix')\n"
+        "C2 = REGISTRY.counter('CamelCase_total', 'case')\n"
+        "H = REGISTRY.histogram('lat', 'no unit')\n"
+        "G = REGISTRY.gauge('depth_total', 'gauge suffix')\n"
+        "L = REGISTRY.counter('ok_total', 'bad label', ('BadLabel',))\n"
+    )
+    found = lint(src, rules=("metric-naming",))
+    assert len(found) == 5
+
+
+def test_metric_naming_clean():
+    src = (
+        "C = REGISTRY.counter('hits_total', 'h', ('kind',))\n"
+        "H = REGISTRY.histogram('lat_seconds', 'l')\n"
+        "G = REGISTRY.gauge('depth', 'd')\n"
+    )
+    assert lint(src, rules=("metric-naming",)) == []
+
+
+def test_metric_registry_direct_constructor_flagged():
+    src = "from ..observability import Counter\n" \
+          "C = Counter('x_total', 'rogue')\n"
+    assert len(lint(src, rules=("metric-registry",))) == 1
+    # inside observability/ the constructors are the implementation
+    obs = "pybitmessage_tpu/observability/fixture.py"
+    assert lint(src, path=obs, rules=("metric-registry",)) == []
+
+
+def test_metric_labels_cardinality():
+    src = (
+        "def f(peer, n):\n"
+        "    C.labels(peer=f'{peer}').inc()\n"
+        "    C.labels(peer=peer).inc()\n"
+        "    C.labels(peer='%s:%d' % (peer, n)).inc()\n"
+        "    C.labels(peer=str(peer)).inc()\n"
+        "    C.labels(peer=peer_bucket(peer)).inc()\n"   # bucketed: ok
+        "    C.labels(kind='static').inc()\n"            # constant: ok
+    )
+    assert len(lint(src, rules=("metric-labels",))) == 4
+
+
+# ---------------------------------------------------------------------------
+# chaos coverage + except discipline
+# ---------------------------------------------------------------------------
+
+CHAOS_FIXTURE = (
+    '"""Sites:\n'
+    "\n"
+    "==================  =====================\n"
+    "``pow.launch``         a documented site\n"
+    "``db.flush``           never planted\n"
+    "==================  =====================\n"
+    '"""\n'
+)
+CHAOS_PATH = "pybitmessage_tpu/resilience/chaos.py"
+
+
+#: chaos coverage rules only fire on a full-package sweep — the
+#: package root marks one (subset runs must not claim sites unused)
+PKG_ROOT = ("pybitmessage_tpu/__init__.py", "")
+
+
+def test_chaos_unused_and_undocumented_sites():
+    user = "def f():\n    inject('pow.launch')\n" \
+           "def g():\n    inject('pow.mystery')\n"
+    found = lint(user, rules=("chaos-site-unused",
+                              "chaos-site-undocumented"),
+                 extra_files=[PKG_ROOT, (CHAOS_PATH, CHAOS_FIXTURE)])
+    by_rule = {f.rule: f for f in found}
+    assert "db.flush" in by_rule["chaos-site-unused"].message
+    assert "pow.mystery" in by_rule["chaos-site-undocumented"].message
+    assert len(found) == 2
+
+
+def test_chaos_coverage_silent_on_subset_sweep():
+    """Without the package root in the file set (a per-path run) the
+    cross-file coverage rules must not fire at all."""
+    found = lint("def f():\n    pass\n",
+                 rules=("chaos-site-unused", "chaos-site-undocumented"),
+                 extra_files=[(CHAOS_PATH, CHAOS_FIXTURE)])
+    assert found == []
+
+
+def test_except_discipline():
+    src = (
+        "def logged_only():\n"
+        "    try:\n"
+        "        work()\n"
+        "    except Exception:\n"
+        "        logger.exception('lost')\n"       # invisible: flagged
+        "def counted():\n"
+        "    try:\n"
+        "        work()\n"
+        "    except Exception:\n"
+        "        ERRORS.labels(site='x').inc()\n"
+        "def reraises():\n"
+        "    try:\n"
+        "        work()\n"
+        "    except Exception:\n"
+        "        logger.exception('up')\n"
+        "        raise\n"
+        "def helper_bookkept(self):\n"
+        "    try:\n"
+        "        work()\n"
+        "    except Exception as exc:\n"
+        "        self._pallas_failed(exc, 'tier')\n"
+    )
+    found = lint(src, rules=("except-discipline",))
+    assert len(found) == 1
+    assert found[0].scope == "logged_only"
+
+
+def test_except_discipline_event_set_is_not_bookkeeping():
+    """``asyncio.Event.set()`` in a handler records nothing — only a
+    metric family's .set() (ALL-CAPS global or .labels() child)
+    satisfies the rule."""
+    src = (
+        "def closes():\n"
+        "    try:\n"
+        "        work()\n"
+        "    except Exception:\n"
+        "        self._closed.set()\n"       # an Event, not a metric
+        "def gauges():\n"
+        "    try:\n"
+        "        work()\n"
+        "    except Exception:\n"
+        "        STATE.set(2)\n"
+        "def labeled(self):\n"
+        "    try:\n"
+        "        work()\n"
+        "    except Exception:\n"
+        "        BREAKER_STATE.labels(breaker=self.label).set(2)\n"
+    )
+    found = lint(src, rules=("except-discipline",))
+    assert [f.scope for f in found] == ["closes"]
+
+
+# ---------------------------------------------------------------------------
+# baseline round-trip + the gate bites
+# ---------------------------------------------------------------------------
+
+
+def _one_finding():
+    src = "async def f(self):\n    __import__('x')\n" \
+          "    time.sleep(1)\n"
+    return run_checkers([(POW, "import time\n" + src)]).findings
+
+
+def test_baseline_round_trip():
+    findings = _one_finding()
+    assert findings
+    doc = build_baseline(findings)
+    new, stale = compare_baseline(findings, doc)
+    assert not new and not stale
+    # removing the baseline entry makes the finding NEW again
+    empty = {"version": 1, "entries": {}}
+    new, stale = compare_baseline(findings, empty)
+    assert len(new) == len(findings)
+    # fixing the finding makes the entry STALE (monotonic shrink)
+    new, stale = compare_baseline([], doc)
+    assert not new and len(stale) == len(findings)
+
+
+def test_baseline_keys_survive_line_shifts():
+    src1 = "import time\nasync def f(self):\n    time.sleep(1)\n"
+    src2 = "import time\n# a\n# comment\n# block\n" \
+           "async def f(self):\n    time.sleep(1)\n"
+    k1 = run_checkers([(POW, src1)]).findings[0].key
+    k2 = run_checkers([(POW, src2)]).findings[0].key
+    assert k1 == k2
+
+
+def test_scope_is_innermost_qualname():
+    """Two identical violations in different methods of one class get
+    DISTINCT method-level fingerprints — a baseline note written for
+    C.f can never silently migrate to C.g."""
+    src = (
+        "import time\n"
+        "class C:\n"
+        "    async def f(self):\n"
+        "        time.sleep(1)\n"
+        "    async def g(self):\n"
+        "        time.sleep(1)\n"
+    )
+    found = lint(src, rules=("loop-blocking",))
+    assert sorted(f.scope for f in found) == ["C.f", "C.g"]
+    assert len({f.key for f in found}) == 2
+    assert all(f.key.endswith(":0") for f in found)
+
+
+def test_baseline_notes_survive_update():
+    findings = _one_finding()
+    doc = build_baseline(findings)
+    key = next(iter(doc["entries"]))
+    doc["entries"][key]["note"] = "justified"
+    doc2 = build_baseline(findings, previous=doc)
+    assert doc2["entries"][key]["note"] == "justified"
+
+
+# ---------------------------------------------------------------------------
+# CLI: JSON golden + exit codes
+# ---------------------------------------------------------------------------
+
+
+def _write_fixture_pkg(tmp_path):
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "mod.py").write_text(
+        "import time\nasync def f(self):\n    time.sleep(1)\n")
+    return pkg
+
+
+def test_cli_json_shape_and_exit_codes(tmp_path, capsys):
+    pkg = _write_fixture_pkg(tmp_path)
+    baseline = tmp_path / "baseline.json"
+
+    rc = main([str(pkg), "--baseline", str(baseline)])
+    out = capsys.readouterr().out
+    assert rc == 1 and "[loop-blocking]" in out
+
+    rc = main([str(pkg), "--baseline", str(baseline),
+               "--update-baseline"])
+    capsys.readouterr()
+    assert rc == 0
+
+    rc = main([str(pkg), "--baseline", str(baseline), "--json"])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert doc["version"] == 1
+    assert doc["counts"] == {"findings": 1, "new": 0, "stale": 0,
+                             "baselined": 1, "suppressed": 0}
+    f = doc["findings"][0]
+    assert f["rule"] == "loop-blocking"
+    assert f["baselined"] is True
+    assert f["severity"] == "warning"    # tmp dir is not a critical dir
+    assert set(f) >= {"rule", "file", "line", "col", "severity",
+                      "scope", "message", "key"}
+
+
+def test_cli_gate_bites_on_removed_baseline_entry(tmp_path, capsys):
+    """Acceptance: removing a single baseline entry for a seeded
+    violation flips the exit to non-zero (new finding), and fixing the
+    violation without updating the baseline ALSO fails (stale)."""
+    pkg = _write_fixture_pkg(tmp_path)
+    baseline = tmp_path / "baseline.json"
+    assert main([str(pkg), "--baseline", str(baseline),
+                 "--update-baseline"]) == 0
+    capsys.readouterr()
+    doc = json.loads(baseline.read_text())
+    assert len(doc["entries"]) == 1
+    baseline.write_text(json.dumps({"version": 1, "entries": {}}))
+    assert main([str(pkg), "--baseline", str(baseline)]) == 1
+    capsys.readouterr()
+    # restore the entry, then fix the code: stale entry must fail too
+    baseline.write_text(json.dumps(doc))
+    (pkg / "mod.py").write_text("async def f(self):\n    pass\n")
+    rc = main([str(pkg), "--baseline", str(baseline)])
+    out = capsys.readouterr().out
+    assert rc == 1 and "STALE" in out
+
+
+def test_cli_list_rules(capsys):
+    assert main(["--list-rules"]) == 0
+    rules = capsys.readouterr().out.split()
+    for rule in ("loop-blocking", "await-race", "silent-swallow",
+                 "metric-naming", "metric-labels", "metric-registry",
+                 "chaos-site-unused", "chaos-site-undocumented",
+                 "except-discipline", "unawaited-coro",
+                 "untracked-task"):
+        assert rule in rules
+
+
+def test_parse_error_is_a_finding():
+    res = run_checkers([("pybitmessage_tpu/pow/bad.py", "def broken(:\n")])
+    assert [f.rule for f in res.findings] == ["parse-error"]
+
+
+# ---------------------------------------------------------------------------
+# the tier-1 repo gate: the committed tree lints clean, and the seeded
+# in-tree suppressions really are load-bearing
+# ---------------------------------------------------------------------------
+
+
+@functools.cache
+def repo_files():
+    return tuple(collect_files(DEFAULT_ROOTS))
+
+
+@functools.cache
+def repo_new_and_stale():
+    """ONE shared full-repo sweep + baseline diff — several tier-1
+    gates (here and in test_observability.py) read it instead of each
+    re-parsing the whole tree."""
+    res = run_checkers(list(repo_files()))
+    doc = load_baseline(DEFAULT_BASELINE)
+    new, stale = compare_baseline(res.findings, doc,
+                                  scanned={p for p, _ in repo_files()})
+    return new, stale
+
+
+def test_repo_lints_clean_against_committed_baseline():
+    """``make lint`` semantics inside tier-1: no new findings, no
+    stale baseline entries, and every baseline entry carries a
+    one-line justification note."""
+    new, stale = repo_new_and_stale()
+    assert not new, "new bmlint findings:\n%s" % "\n".join(
+        "%s %s %s" % (f.location(), f.rule, f.message) for f in new)
+    assert not stale, "stale baseline entries (run --update-baseline " \
+        "to record the shrunk debt): %s" % stale
+    for key, entry in load_baseline(DEFAULT_BASELINE)["entries"].items():
+        assert entry.get("note"), "baseline entry %s has no " \
+            "justification note" % key
+
+
+def test_repo_seeded_suppressions_are_load_bearing():
+    """Acceptance: stripping any in-tree ``bmlint: allow`` comment
+    resurfaces its finding (the suppression is not dead weight).
+    Suppressed rules are all per-file, so each file is re-linted
+    alone — no full-tree re-sweep per suppression."""
+    suppressed_paths = [
+        (path, src) for path, src in repo_files()
+        if src and "bmlint: allow(" in src
+        and "tools/bmlint" not in path and not path.startswith("tests/")]
+    assert suppressed_paths, "expected seeded suppressions in-tree"
+    for path, src in suppressed_paths:
+        before = run_checkers([(path, src)]).findings
+        stripped = src.replace("# bmlint: allow(", "# was: (")
+        after = run_checkers([(path, stripped)]).findings
+        extra = {f.key for f in after} - {f.key for f in before}
+        assert extra, "suppression in %s silences nothing" % path
+
+
+def test_subset_run_is_safe(tmp_path, capsys):
+    """A per-path run must neither report baseline entries for
+    unscanned files as stale nor erase them on --update-baseline."""
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "a.py").write_text(
+        "import time\nasync def f(self):\n    time.sleep(1)\n")
+    (pkg / "b.py").write_text(
+        "import time\nasync def g(self):\n    time.sleep(1)\n")
+    baseline = tmp_path / "baseline.json"
+    assert main([str(pkg), "--baseline", str(baseline),
+                 "--update-baseline"]) == 0
+    capsys.readouterr()
+    doc = json.loads(baseline.read_text())
+    assert len(doc["entries"]) == 2
+    # subset gate: b.py's entry is out of scope, not stale
+    assert main([str(pkg / "a.py"), "--baseline", str(baseline)]) == 0
+    capsys.readouterr()
+    # subset update: fix a.py, update over the subset — b.py's entry
+    # (and its note) survives
+    for e in doc["entries"].values():
+        e["note"] = "kept"
+    baseline.write_text(json.dumps(doc))
+    (pkg / "a.py").write_text("async def f(self):\n    pass\n")
+    assert main([str(pkg / "a.py"), "--baseline", str(baseline),
+                 "--update-baseline"]) == 0
+    capsys.readouterr()
+    doc2 = json.loads(baseline.read_text())
+    assert len(doc2["entries"]) == 1
+    entry = next(iter(doc2["entries"].values()))
+    assert entry["file"].endswith("b.py") and entry["note"] == "kept"
+
+
+def test_deleted_file_entry_goes_stale(tmp_path, capsys):
+    """A baselined file that is DELETED from a swept root must make
+    its entries stale (exit 1) and drop them on --update-baseline —
+    not live forever because the file no longer appears in the
+    scanned set."""
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "doomed.py").write_text(
+        "import time\nasync def f(self):\n    time.sleep(1)\n")
+    baseline = tmp_path / "baseline.json"
+    assert main([str(pkg), "--baseline", str(baseline),
+                 "--update-baseline"]) == 0
+    capsys.readouterr()
+    (pkg / "doomed.py").unlink()
+    rc = main([str(pkg), "--baseline", str(baseline)])
+    out = capsys.readouterr().out
+    assert rc == 1 and "STALE" in out
+    assert main([str(pkg), "--baseline", str(baseline),
+                 "--update-baseline"]) == 0
+    capsys.readouterr()
+    assert json.loads(baseline.read_text())["entries"] == {}
+
+
+def test_undecodable_file_is_a_finding(tmp_path, capsys):
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "latin.py").write_bytes(b"# caf\xe9\n")
+    rc = main([str(pkg), "--baseline", str(tmp_path / "b.json")])
+    out = capsys.readouterr().out
+    assert rc == 1 and "not valid UTF-8" in out

@@ -1,0 +1,2 @@
+"""Operator tools (``python -m tools.<name>``): bench_compare,
+flightrec_merge, and the bmlint static-analysis gate."""

@@ -15,6 +15,10 @@ regression beyond its band exits non-zero — wired as ``make
 perfguard`` and the ``perfguard`` tox env, so a PR that quietly erodes
 the pipeline/ingest/sync wins fails CI instead of shipping.
 
+Like everything under ``tools/``, this script is swept by the bmlint
+gate (``make lint``, docs/static_analysis.md) at the package's own
+severity tier — swallow/naming/discipline rules included.
+
 Tolerances are deliberately wide for wall-clock rates (CI machines are
 noisy; a band catches collapses, not jitter) and tight for
 machine-independent ratios and invariants (sync reduction factors,

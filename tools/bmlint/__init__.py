@@ -1,0 +1,33 @@
+"""bmlint — project-native static analysis for pybitmessage-tpu.
+
+Proves the codebase's concurrency and resilience conventions at
+commit time instead of in chaos runs: crypto/SQL off the event loop,
+no read-modify-write across awaits without a lock, no silent broad
+excepts, metrics through ``observability.REGISTRY`` with bounded
+label cardinality, and full chaos-site coverage.
+
+Entry points:
+
+- ``python -m tools.bmlint`` (== ``make lint``) — sweep the package
+  and ``tools/`` against the committed baseline;
+- :func:`tools.bmlint.core.run_checkers` — in-memory API the tests
+  drive with fixture snippets;
+- docs/static_analysis.md — rule catalog, suppression syntax,
+  baseline workflow, how to add a checker.
+"""
+
+from .baseline import build as build_baseline
+from .baseline import compare as compare_baseline
+from .baseline import load as load_baseline
+from .baseline import save as save_baseline
+from .checkers import ALL_RULES, CHECKERS, default_checkers
+from .core import (CRITICAL_DIRS, FileCtx, Finding, RunResult,
+                   parse_suppressions, run_checkers)
+
+__all__ = [
+    "Finding", "FileCtx", "RunResult", "run_checkers",
+    "parse_suppressions", "CRITICAL_DIRS",
+    "CHECKERS", "ALL_RULES", "default_checkers",
+    "load_baseline", "save_baseline", "build_baseline",
+    "compare_baseline",
+]

@@ -1,0 +1,166 @@
+#!/usr/bin/env python3
+"""bmlint CLI — ``make lint`` / the ``lint`` tox env.
+
+    python -m tools.bmlint                       # gate vs baseline
+    python -m tools.bmlint --json                # machine-readable
+    python -m tools.bmlint --update-baseline     # record shrunk debt
+    python -m tools.bmlint --no-baseline pkg/    # raw findings
+
+Exit codes: 0 clean (every finding baselined, no stale entries),
+1 new or stale findings, 2 usage / unreadable input.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+if __package__ in (None, ""):        # `python tools/bmlint` direct run
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))))
+    from tools.bmlint import __main__ as _m
+    sys.exit(_m.main())
+
+from . import baseline as baseline_mod
+from .checkers import ALL_RULES, default_checkers
+from .core import run_checkers
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+DEFAULT_BASELINE = os.path.join(os.path.dirname(os.path.abspath(
+    __file__)), "baseline.json")
+DEFAULT_ROOTS = ("pybitmessage_tpu", "tools")
+_SKIP_DIRS = {"__pycache__", "locale", ".git"}
+
+
+def collect_files(roots) -> list[tuple[str, str]]:
+    """``(repo-relative path, source)`` for every .py under roots."""
+    out = []
+    for root in roots:
+        abs_root = root if os.path.isabs(root) \
+            else os.path.join(REPO_ROOT, root)
+        if os.path.isfile(abs_root):
+            paths = [abs_root]
+        else:
+            paths = []
+            for dirpath, dirnames, filenames in os.walk(abs_root):
+                dirnames[:] = sorted(d for d in dirnames
+                                     if d not in _SKIP_DIRS)
+                paths.extend(os.path.join(dirpath, fn)
+                             for fn in sorted(filenames)
+                             if fn.endswith(".py"))
+        for path in paths:
+            rel = os.path.relpath(path, REPO_ROOT).replace(os.sep, "/")
+            try:
+                with open(path, encoding="utf-8") as f:
+                    out.append((rel, f.read()))
+            except UnicodeDecodeError:
+                # surfaced as a parse-error finding, not a crash
+                out.append((rel, None))
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="bmlint", description=__doc__.splitlines()[0])
+    ap.add_argument("paths", nargs="*", default=None,
+                    help="files/dirs to lint (default: %s)"
+                         % " ".join(DEFAULT_ROOTS))
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit the machine-readable report")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                    help="baseline file (default: %(default)s)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report raw findings; exit 1 when any exist")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="rewrite the baseline from this run "
+                         "(notes of surviving entries are kept)")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule catalog and exit")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rule in ALL_RULES:
+            print(rule)
+        return 0
+
+    try:
+        files = collect_files(args.paths or DEFAULT_ROOTS)
+    except OSError as exc:
+        sys.stderr.write("bmlint: %s\n" % exc)
+        return 2
+    result = run_checkers(files, default_checkers())
+    # the run's scope: swept files plus swept directory roots as
+    # "dir/" prefixes — baseline entries outside it are neither stale
+    # nor erasable (subset-run safety), while entries under a swept
+    # root whose file was DELETED correctly go stale
+    scanned = {rel for rel, _ in files}
+    for root in (args.paths or DEFAULT_ROOTS):
+        abs_root = root if os.path.isabs(root) \
+            else os.path.join(REPO_ROOT, root)
+        if os.path.isdir(abs_root):
+            rel = os.path.relpath(abs_root, REPO_ROOT).replace(
+                os.sep, "/")
+            scanned.add(rel.rstrip("/") + "/")
+
+    if args.update_baseline:
+        previous = baseline_mod.load(args.baseline)
+        doc = baseline_mod.build(result.findings, previous,
+                                 scanned=scanned)
+        baseline_mod.save(args.baseline, doc)
+        blank = sum(1 for e in doc["entries"].values()
+                    if not e["note"])
+        print("bmlint: baseline updated -> %s (%d entries%s)"
+              % (args.baseline, len(doc["entries"]),
+                 ", %d need a justification note" % blank
+                 if blank else ""))
+        return 0
+
+    if args.no_baseline:
+        new, stale = list(result.findings), []
+        baselined = []
+    else:
+        try:
+            doc = baseline_mod.load(args.baseline)
+        except ValueError as exc:
+            sys.stderr.write("bmlint: %s\n" % exc)
+            return 2
+        new, stale = baseline_mod.compare(result.findings, doc,
+                                          scanned=scanned)
+        newkeys = {f.key for f in new}
+        baselined = [f for f in result.findings
+                     if f.key not in newkeys]
+
+    if args.as_json:
+        print(json.dumps({
+            "version": 1,
+            "files": result.files,
+            "counts": {"findings": len(result.findings),
+                       "new": len(new), "stale": len(stale),
+                       "baselined": len(baselined),
+                       "suppressed": len(result.suppressed)},
+            "findings": [dict(f.as_dict(),
+                              baselined=f.key not in {n.key
+                                                      for n in new})
+                         for f in result.findings],
+            "new": [f.key for f in new],
+            "stale": stale,
+        }, indent=2, sort_keys=True))
+    else:
+        for f in new:
+            print("%s:%d: [%s] %s (%s)" % (f.path, f.line, f.rule,
+                                           f.message, f.severity))
+        for key in stale:
+            print("STALE baseline entry %s — the finding is gone; "
+                  "run --update-baseline to shrink the debt" % key)
+        print("bmlint: %d files, %d findings (%d baselined, "
+              "%d suppressed in-line), %d new, %d stale"
+              % (result.files, len(result.findings), len(baselined),
+                 len(result.suppressed), len(new), len(stale)))
+    return 1 if (new or stale) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,105 @@
+"""Baseline workflow: pre-existing findings are acknowledged, new ones
+fail, and the debt can only shrink.
+
+The committed baseline (``tools/bmlint/baseline.json``) maps each
+acknowledged finding's stable fingerprint to a one-line justification:
+
+    {"version": 1, "entries": {"<key>": {"note": "...", ...}}}
+
+Gate semantics (docs/static_analysis.md):
+
+- a finding whose key is NOT in the baseline is **new** -> exit 1;
+- a baseline entry whose key no longer matches any finding is
+  **stale** -> exit 1 ("the debt shrank: run --update-baseline to
+  record it").  This is what makes the baseline monotonically
+  shrinking — fixing a violation forces a baseline update in the same
+  PR, so the file's history IS the debt burndown.
+
+``--update-baseline`` rewrites the file from the current findings,
+preserving notes for keys that survive; brand-new entries get an
+empty note the author must fill in (review-enforced).
+"""
+
+from __future__ import annotations
+
+import json
+
+from .core import Finding
+
+VERSION = 1
+
+
+def load(path: str) -> dict:
+    """Parsed baseline; an empty one when the file is absent."""
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except FileNotFoundError:
+        return {"version": VERSION, "entries": {}}
+    if not isinstance(doc, dict) or \
+            not isinstance(doc.get("entries"), dict):
+        raise ValueError("%s: not a bmlint baseline" % path)
+    return doc
+
+
+def in_scope(path: str, scanned: set[str] | None) -> bool:
+    """Whether a baseline entry's file is covered by this run.
+
+    ``scanned`` holds the swept file paths PLUS the swept directory
+    roots as ``dir/`` prefixes.  A file under a swept root is in
+    scope even when it no longer exists on disk — that is what makes
+    a DELETED file's entries stale instead of immortal.  ``None``
+    means everything is in scope (pure-API full sweep)."""
+    if scanned is None:
+        return True
+    return path in scanned or any(
+        p.endswith("/") and path.startswith(p) for p in scanned)
+
+
+def compare(findings: list[Finding], baseline: dict,
+            scanned: set[str] | None = None
+            ) -> tuple[list[Finding], list[str]]:
+    """(new_findings, stale_keys) against the baseline entries.
+
+    An entry for a file outside this run's scope (see
+    :func:`in_scope`) is neither expected nor stale — a ``bmlint
+    some/subdir`` run must not flag the rest of the baseline as
+    gone."""
+    entries = baseline.get("entries", {})
+    current_keys = {f.key for f in findings}
+    new = [f for f in findings if f.key not in entries]
+    stale = sorted(
+        k for k, e in entries.items()
+        if k not in current_keys and in_scope(e.get("file", ""),
+                                             scanned))
+    return new, stale
+
+
+def build(findings: list[Finding], previous: dict | None = None,
+          scanned: set[str] | None = None) -> dict:
+    """A fresh baseline doc from ``findings``, carrying over notes of
+    surviving entries from ``previous``.
+
+    Previous entries OUTSIDE this run's scope are preserved verbatim
+    (notes included), so ``--update-baseline`` over a path subset
+    cannot erase the rest of the recorded debt; in-scope entries are
+    rebuilt from the current findings, so entries of deleted files
+    drop out."""
+    old = (previous or {}).get("entries", {})
+    entries = {}
+    for key, e in old.items():
+        if not in_scope(e.get("file", ""), scanned):
+            entries[key] = dict(e)
+    for f in sorted(findings, key=lambda f: f.key):
+        note = old.get(f.key, {}).get("note", "")
+        entries[f.key] = {
+            "rule": f.rule, "file": f.path, "line": f.line,
+            "severity": f.severity, "message": f.message, "note": note,
+        }
+    return {"version": VERSION, "entries": entries}
+
+
+def save(path: str, doc: dict) -> None:
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=2, sort_keys=True)
+        f.write("\n")

@@ -1,0 +1,27 @@
+"""Checker registry.  Adding a checker = one module with a class
+exposing ``name``/``rules``/``check_file``/``finish`` plus a line here
+(docs/static_analysis.md walks through it)."""
+
+from .awaitrace import AwaitRaceChecker
+from .blocking import BlockingCallChecker
+from .chaos import ResilienceChecker
+from .metricsconv import MetricsChecker
+from .swallow import SilentSwallowChecker
+
+#: checker classes in report order
+CHECKERS = (
+    BlockingCallChecker,
+    AwaitRaceChecker,
+    SilentSwallowChecker,
+    MetricsChecker,
+    ResilienceChecker,
+)
+
+#: every rule id any checker can emit (CLI validation, docs test)
+ALL_RULES = tuple(sorted(
+    {rule for cls in CHECKERS for rule in cls.rules} | {"parse-error"}))
+
+
+def default_checkers() -> list:
+    """Fresh checker instances (finish() state is per-run)."""
+    return [cls() for cls in CHECKERS]

@@ -1,0 +1,282 @@
+"""Checker: await-boundary races and coroutine lifecycle mistakes.
+
+Three rules:
+
+- ``await-race`` — inside one ``async def``, a write to ``self.X``
+  whose value was READ from ``self.X`` on the far side of an
+  ``await`` (directly — ``self.x = self.x + await f()`` — or through
+  an alias variable captured before the await) without an
+  ``asyncio.Lock`` held across the boundary.  Another task
+  interleaving at the await makes the write clobber its update — the
+  classic read-modify-write race the asyncio surface invites.  A
+  self-referencing statement with no await inside it
+  (``self.x += 1``) is atomic on the loop and is NOT flagged.
+- ``unawaited-coro`` — an expression statement calls a coroutine
+  function defined in the same module without ``await``: the coroutine
+  is created, never scheduled, and dies with a RuntimeWarning at GC.
+- ``untracked-task`` — ``create_task`` / ``ensure_future`` whose
+  result is discarded: the event loop holds only a weak reference, so
+  the task can be garbage-collected mid-flight.
+
+Lock awareness is lexical: ``async with <expr>`` where the context
+expression's text contains ``lock``/``sem`` marks its body as held.
+Attributes whose own name suggests a synchronization primitive
+(``lock``/``sem``/``event``/``cond``/``queue``) are never tracked —
+mutating those around awaits is their purpose.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import FileCtx, Finding, call_name, dotted
+
+_SYNC_NAME_HINTS = ("lock", "sem", "event", "cond", "queue", "future")
+_TASK_SPAWNERS = ("create_task", "ensure_future")
+
+
+class AwaitRaceChecker:
+    name = "awaitrace"
+    rules = ("await-race", "unawaited-coro", "untracked-task")
+
+    def check_file(self, ctx: FileCtx):
+        out: list[Finding] = []
+        async_names = _module_coroutine_names(ctx.tree)
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.AsyncFunctionDef):
+                _FnScan(ctx, node, out).run()
+        _scan_coro_misuse(ctx, ctx.tree, async_names, out)
+        return out
+
+    def finish(self):
+        return ()
+
+
+def _module_coroutine_names(tree: ast.Module) -> tuple[set[str],
+                                                       set[str]]:
+    """(top-level async function names, async method names of any
+    class in the module).  Only a bare ``name()`` call or a
+    ``self.name()`` call is matched against these — a call on some
+    OTHER object (``conn.start()``) says nothing about that object's
+    class, so it is never flagged."""
+    top = {n.name for n in tree.body
+           if isinstance(n, ast.AsyncFunctionDef)}
+    methods: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef):
+            methods.update(n.name for n in node.body
+                           if isinstance(n, ast.AsyncFunctionDef))
+    return top, methods
+
+
+def _is_sync_primitive(attr: str) -> bool:
+    low = attr.lower()
+    return any(h in low for h in _SYNC_NAME_HINTS)
+
+
+# ---------------------------------------------------------------------------
+# await-race scan
+# ---------------------------------------------------------------------------
+
+
+class _FnScan:
+    """Linear scan of one async function body in evaluation order.
+
+    ``epoch`` counts awaits crossed so far.  Loads of ``self.X``
+    inside a store statement's value are recorded with the epoch at
+    which they are evaluated; alias variables (``cur = self.x``)
+    remember their capture epoch.  A store races when the value it
+    writes was read at a strictly earlier epoch (directly or via an
+    alias) with no lock held — i.e. the read crossed an await before
+    the write landed.  ``self.x += 1`` loads and stores at one epoch:
+    atomic on the loop, never flagged."""
+
+    def __init__(self, ctx: FileCtx, fn: ast.AsyncFunctionDef,
+                 out: list[Finding]):
+        self.ctx = ctx
+        self.fn = fn
+        self.out = out
+        self.epoch = 0
+        self.lock = 0
+        #: loads recorded while walking the CURRENT statement's
+        #: expressions: attr -> earliest epoch read at
+        self._stmt_loads: dict[str, int] = {}
+        #: var -> (attr it aliases, epoch captured at)
+        self.aliases: dict[str, tuple[str, int]] = {}
+        self._flagged: set[str] = set()
+
+    def run(self) -> None:
+        for stmt in self.fn.body:
+            self._stmt(stmt)
+
+    # -- expression walk (evaluation order, epoch-bumping) -------------------
+
+    def _expr(self, node: ast.AST | None) -> None:
+        if node is None:
+            return
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            return
+        if isinstance(node, ast.Await):
+            self._expr(node.value)
+            self.epoch += 1
+            return
+        if isinstance(node, ast.Attribute) and \
+                isinstance(node.value, ast.Name) and \
+                node.value.id == "self" and \
+                isinstance(node.ctx, ast.Load) and \
+                not _is_sync_primitive(node.attr):
+            self._stmt_loads.setdefault(node.attr, self.epoch)
+        for child in ast.iter_child_nodes(node):
+            self._expr(child)
+
+    def _self_attr_target(self, target: ast.AST) -> str | None:
+        if isinstance(target, ast.Attribute) and \
+                isinstance(target.value, ast.Name) and \
+                target.value.id == "self":
+            return target.attr
+        return None
+
+    def _read_epoch(self, attr: str, value: ast.AST) -> int | None:
+        """Earliest epoch at which the stored value read ``self.attr``
+        — via a direct load inside this statement or an alias variable
+        referenced by the value."""
+        earliest = self._stmt_loads.get(attr)
+        for node in ast.walk(value):
+            if isinstance(node, ast.Name) and \
+                    isinstance(node.ctx, ast.Load):
+                alias = self.aliases.get(node.id)
+                if alias and alias[0] == attr:
+                    cap = alias[1]
+                    if earliest is None or cap < earliest:
+                        earliest = cap
+        return earliest
+
+    def _maybe_flag(self, attr: str, value: ast.AST,
+                    stmt: ast.stmt) -> None:
+        if attr in self._flagged or _is_sync_primitive(attr) or \
+                self.lock > 0:
+            return
+        read_at = self._read_epoch(attr, value)
+        if read_at is not None and read_at < self.epoch:
+            self._flagged.add(attr)
+            self.out.append(self.ctx.finding(
+                "await-race", stmt,
+                "self.%s is written from a value read before an await "
+                "in `%s` without an asyncio.Lock — an interleaving "
+                "task's update is lost (read-modify-write across the "
+                "await boundary)" % (attr, self.fn.name)))
+
+    # -- statements ----------------------------------------------------------
+
+    def _stmt(self, stmt: ast.stmt) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return
+        if isinstance(stmt, ast.Assign):
+            self._stmt_loads = {}
+            self._expr(stmt.value)
+            for target in stmt.targets:
+                attr = self._self_attr_target(target)
+                if attr is not None:
+                    self._maybe_flag(attr, stmt.value, stmt)
+                elif isinstance(target, ast.Name):
+                    if isinstance(stmt.value, ast.Attribute) and \
+                            isinstance(stmt.value.value, ast.Name) and \
+                            stmt.value.value.id == "self" and \
+                            not _is_sync_primitive(stmt.value.attr):
+                        self.aliases[target.id] = (stmt.value.attr,
+                                                   self.epoch)
+                    else:
+                        self.aliases.pop(target.id, None)
+                else:
+                    self._expr(target)
+            return
+        if isinstance(stmt, ast.AugAssign):
+            attr = self._self_attr_target(stmt.target)
+            self._stmt_loads = {}
+            if attr is not None and not _is_sync_primitive(attr):
+                # the in-place load happens before the value evaluates
+                self._stmt_loads[attr] = self.epoch
+            self._expr(stmt.value)
+            if attr is not None:
+                # racy only when the value evaluation crossed an await
+                # (e.g. ``self.x += await f()``)
+                self._maybe_flag(attr, stmt.value, stmt)
+            return
+        if isinstance(stmt, (ast.While, ast.For, ast.AsyncFor)):
+            self._stmt_loads = {}
+            if isinstance(stmt, ast.While):
+                self._expr(stmt.test)
+            else:
+                self._expr(getattr(stmt, "iter", None))
+            for s in stmt.body + stmt.orelse:
+                self._stmt(s)
+            return
+        if isinstance(stmt, ast.If):
+            self._stmt_loads = {}
+            self._expr(stmt.test)
+            for s in stmt.body + stmt.orelse:
+                self._stmt(s)
+            return
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            def _ctx_name(expr: ast.AST) -> str:
+                if isinstance(expr, ast.Call):
+                    return call_name(expr)
+                return dotted(expr)
+            is_lock = any(_is_sync_primitive(_ctx_name(i.context_expr))
+                          for i in stmt.items)
+            self._stmt_loads = {}
+            for item in stmt.items:
+                self._expr(item.context_expr)
+            if is_lock and isinstance(stmt, ast.AsyncWith):
+                self.lock += 1
+                for s in stmt.body:
+                    self._stmt(s)
+                self.lock -= 1
+            else:
+                for s in stmt.body:
+                    self._stmt(s)
+            return
+        if isinstance(stmt, ast.Try):
+            for s in stmt.body:
+                self._stmt(s)
+            for handler in stmt.handlers:
+                for s in handler.body:
+                    self._stmt(s)
+            for s in stmt.orelse + stmt.finalbody:
+                self._stmt(s)
+            return
+        self._stmt_loads = {}
+        for child in ast.iter_child_nodes(stmt):
+            self._expr(child)
+
+
+# ---------------------------------------------------------------------------
+# unawaited coroutines / dropped tasks
+# ---------------------------------------------------------------------------
+
+
+def _scan_coro_misuse(ctx: FileCtx, tree: ast.AST,
+                      async_names: tuple[set[str], set[str]],
+                      out: list[Finding]) -> None:
+    top_level, methods = async_names
+    for stmt in ast.walk(tree):
+        if not isinstance(stmt, ast.Expr) or \
+                not isinstance(stmt.value, ast.Call):
+            continue
+        call = stmt.value
+        name = call_name(call)
+        last = name.rsplit(".", 1)[-1]
+        if last in _TASK_SPAWNERS:
+            out.append(ctx.finding(
+                "untracked-task", stmt,
+                "%s(...) result discarded — the loop keeps only a "
+                "weak reference, so the task can be GC'd mid-flight; "
+                "hold it (utils.tasks) or await it" % last))
+        elif (isinstance(call.func, ast.Name) and name in top_level) \
+                or (name == "self.%s" % last and last in methods):
+            out.append(ctx.finding(
+                "unawaited-coro", stmt,
+                "coroutine `%s` called without await — it is never "
+                "scheduled" % last))

@@ -1,0 +1,159 @@
+"""Checker: known-blocking calls reachable from ``async def`` bodies.
+
+The repo's standing convention (ROADMAP, docs/ingest.md) is that
+crypto and SQL stay OFF the event loop: SQLite goes through the
+write-behind drain or an executor hop, crypto through
+``CryptoPool``/``BatchCryptoEngine``, and nothing on the loop calls
+``time.sleep`` / ``subprocess`` / blocking file I/O inline.  This
+checker flags direct calls to known-blocking APIs lexically inside an
+``async def`` body.
+
+Nested ``def``/``lambda`` bodies are skipped: they are exactly how
+blocking work is handed to ``run_in_executor`` / ``CryptoPool.run``,
+so code inside them runs off the loop (or is somebody else's call
+site).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import FileCtx, Finding, call_name, dotted
+
+#: attribute-call names that hit SQLite / the DB layer when invoked on
+#: a database-ish receiver (see _DB_RECEIVERS)
+_DB_METHODS = frozenset({
+    "execute", "executemany", "executescript", "execute_batch",
+    "query", "vacuum", "commit", "fetchall", "fetchone",
+})
+_DB_RECEIVERS = frozenset({
+    "db", "_db", "database", "conn", "_conn", "cur", "cursor",
+    "journal", "_journal",
+})
+
+#: ``subprocess`` entry points that block until the child exits (or
+#: spawn synchronously)
+_SUBPROCESS = frozenset({
+    "run", "call", "check_call", "check_output", "Popen",
+    "getoutput", "getstatusoutput",
+})
+
+#: crypto-package entry points that run the scalar-mult ladder on the
+#: calling thread — these must hop through CryptoPool / the batch
+#: engine when called from the loop
+_CRYPTO_BLOCKING = frozenset({"decrypt", "encrypt", "verify", "sign"})
+
+def _is_crypto_module(mod: str) -> bool:
+    """The crypto package or any of its submodules, however imported
+    (``from ..crypto import sign`` parses as module="crypto" with a
+    level; ``from ..crypto.signing import sign`` as
+    module="crypto.signing"; absolute spellings carry the package
+    prefix)."""
+    return (mod == "crypto" or mod.startswith("crypto.")
+            or mod.endswith(".crypto") or ".crypto." in mod)
+
+
+class BlockingCallChecker:
+    name = "blocking"
+    rules = ("loop-blocking",)
+
+    def check_file(self, ctx: FileCtx):
+        imports = _ImportIndex(ctx.tree)
+        out: list[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.AsyncFunctionDef):
+                _scan_async_body(ctx, node, imports, out)
+        return out
+
+    def finish(self):
+        return ()
+
+
+class _ImportIndex:
+    """Which local names are the ``time``/``subprocess``/``sqlite3``
+    modules or blocking crypto entry points."""
+
+    def __init__(self, tree: ast.Module):
+        self.time_mods: set[str] = set()
+        self.subprocess_mods: set[str] = set()
+        self.sqlite_mods: set[str] = set()
+        self.time_sleep_names: set[str] = set()
+        self.crypto_names: set[str] = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    local = alias.asname or alias.name
+                    if alias.name == "time":
+                        self.time_mods.add(local)
+                    elif alias.name == "subprocess":
+                        self.subprocess_mods.add(local)
+                    elif alias.name == "sqlite3":
+                        self.sqlite_mods.add(local)
+            elif isinstance(node, ast.ImportFrom):
+                mod = node.module or ""
+                if mod == "time":
+                    for alias in node.names:
+                        if alias.name == "sleep":
+                            self.time_sleep_names.add(
+                                alias.asname or alias.name)
+                elif _is_crypto_module(mod):
+                    for alias in node.names:
+                        if alias.name in _CRYPTO_BLOCKING:
+                            self.crypto_names.add(
+                                alias.asname or alias.name)
+
+
+def _scan_async_body(ctx: FileCtx, fn: ast.AsyncFunctionDef,
+                     imports: _ImportIndex, out: list[Finding]) -> None:
+    """Flag blocking calls lexically on the loop: walk the async body
+    but do not descend into nested function/lambda bodies (executor
+    payloads) or further async defs (scanned on their own)."""
+
+    def visit(node: ast.AST) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            return
+        if isinstance(node, ast.Call):
+            verdict = _classify(node, imports)
+            if verdict:
+                out.append(ctx.finding(
+                    "loop-blocking", node,
+                    "%s called on the event loop inside async "
+                    "function; %s" % verdict))
+        for child in ast.iter_child_nodes(node):
+            visit(child)
+
+    for stmt in fn.body:
+        visit(stmt)
+
+
+def _classify(call: ast.Call,
+              imports: _ImportIndex) -> tuple[str, str] | None:
+    """(what, remedy) when the call blocks; None otherwise."""
+    name = call_name(call)
+    root, _, _ = name.partition(".")
+    last = name.rsplit(".", 1)[-1]
+
+    if name in imports.time_sleep_names or (
+            root in imports.time_mods and last == "sleep"):
+        return (name, "use `await asyncio.sleep(...)`")
+    if root in imports.subprocess_mods and last in _SUBPROCESS:
+        return (name, "use `asyncio.create_subprocess_exec` or an "
+                      "executor hop")
+    if root in imports.sqlite_mods:
+        return (name, "SQLite stays off the loop — go through the "
+                      "storage layer / an executor")
+    if name in imports.crypto_names:
+        return (name, "route through CryptoPool / the batch engine "
+                      "(docs/ingest.md)")
+    if name == "open":
+        return (name, "blocking file I/O — hop through an executor "
+                      "or do it before entering the loop")
+    if isinstance(call.func, ast.Attribute) and last in _DB_METHODS:
+        receiver = dotted(call.func.value)
+        seg = receiver.rsplit(".", 1)[-1] if receiver else ""
+        if seg in _DB_RECEIVERS or seg.endswith("db"):
+            return ("%s (SQL)" % name,
+                    "SQL stays off the loop — write-behind buffer or "
+                    "executor hop (docs/ingest.md)")
+    return None

@@ -1,0 +1,161 @@
+"""Checker: chaos-site coverage and except-handler discipline.
+
+Two halves of the resilience convention (docs/resilience.md):
+
+- chaos coverage — ``resilience/chaos.py`` documents the injection-site
+  catalog in its module docstring (rows shaped ````site.name```` ).
+  Every cataloged site must be planted via ``inject("<site>")``
+  somewhere in the package (``chaos-site-unused``), and every planted
+  site must be cataloged (``chaos-site-undocumented``) — otherwise the
+  chaos suite silently stops exercising a failure path, or a new path
+  ships without a documented knob.
+- ``except-discipline`` — broad ``except`` handlers in the failure-
+  critical packages (pow/, network/, sync/, crypto/) must re-raise,
+  count into a metric (``.inc(...)`` — by convention
+  ``resilience_errors_total``), or feed a breaker
+  (``record_failure``).  A handler that only logs leaves the error
+  invisible to ``GET /metrics`` and the chaos acceptance counters.
+  Purely-silent bodies are the swallow checker's finding and are not
+  double-reported here.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from ..core import (FileCtx, Finding, call_name, dotted,
+                    is_broad_except, is_silent_stmt, str_const)
+
+_CATALOG_ROW = re.compile(r"^``([a-z_][a-z0-9_.]*)``", re.MULTILINE)
+_DISCIPLINE_DIRS = frozenset({"pow", "network", "sync", "crypto"})
+_CHAOS_MODULE = "pybitmessage_tpu/resilience/chaos.py"
+
+
+class ResilienceChecker:
+    name = "resilience"
+    rules = ("chaos-site-unused", "chaos-site-undocumented",
+             "except-discipline")
+
+    def __init__(self):
+        self._catalog: dict[str, int] = {}      # site -> docstring line
+        self._catalog_path: str | None = None
+        self._used_sites: set[str] = set()
+        self._undocumented: dict[str, Finding] = {}
+        self._full_sweep = False
+
+    def check_file(self, ctx: FileCtx):
+        out: list[Finding] = []
+        if ctx.relpath == "pybitmessage_tpu/__init__.py":
+            # seeing the package root means the whole package is in
+            # this sweep — only then is "no inject() found" evidence
+            # of a coverage gap rather than of a path-subset run
+            self._full_sweep = True
+        if ctx.relpath.endswith(_CHAOS_MODULE) or \
+                ctx.relpath == "resilience/chaos.py":
+            self._read_catalog(ctx)
+            return out      # the registry itself plants no sites
+        if ctx.relpath.startswith("pybitmessage_tpu/"):
+            self._collect_injects(ctx)
+        if ctx.top_dir in _DISCIPLINE_DIRS:
+            self._check_discipline(ctx, out)
+        return out
+
+    def finish(self):
+        out: list[Finding] = []
+        if self._catalog_path is None or not self._full_sweep:
+            return out
+        for site, line in sorted(self._catalog.items()):
+            if site not in self._used_sites:
+                out.append(Finding(
+                    rule="chaos-site-unused", path=self._catalog_path,
+                    line=line, col=0, severity="error",
+                    scope="<module>",
+                    message="cataloged chaos site %r is never "
+                            "inject()ed — the chaos suite no longer "
+                            "exercises this failure path" % site))
+        for site, f in sorted(self._undocumented.items()):
+            if site not in self._catalog:
+                out.append(f)
+        return out
+
+    # -- catalog / plant sites -----------------------------------------------
+
+    def _read_catalog(self, ctx: FileCtx) -> None:
+        self._catalog_path = ctx.relpath
+        doc = ast.get_docstring(ctx.tree, clean=False) or ""
+        doc_line = 1
+        for m in _CATALOG_ROW.finditer(doc):
+            line = doc_line + doc[:m.start()].count("\n")
+            self._catalog[m.group(1)] = line
+
+    def _collect_injects(self, ctx: FileCtx) -> None:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if call_name(node).rsplit(".", 1)[-1] != "inject":
+                continue
+            site = str_const(node.args[0] if node.args else None)
+            if site is None:
+                continue
+            self._used_sites.add(site)
+            f = ctx.finding(
+                "chaos-site-undocumented", node,
+                "inject(%r) is not in the resilience/chaos.py site "
+                "catalog — document the site so operators can arm it"
+                % site)
+            if not ctx.is_suppressed(f):
+                self._undocumented.setdefault(site, f)
+
+    # -- except discipline ---------------------------------------------------
+
+    def _check_discipline(self, ctx: FileCtx,
+                          out: list[Finding]) -> None:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.ExceptHandler) or \
+                    not is_broad_except(node.type):
+                continue
+            if all(is_silent_stmt(s) for s in node.body):
+                continue        # the swallow checker's finding
+            if self._body_disciplined(node.body):
+                continue
+            out.append(ctx.finding(
+                "except-discipline", node,
+                "broad except in %s/ neither re-raises nor counts "
+                "into a metric — count it (resilience_errors_total) "
+                "or feed a breaker so the failure is visible to "
+                "/metrics (docs/resilience.md)" % ctx.top_dir))
+
+    def _body_disciplined(self, body: list[ast.stmt]) -> bool:
+        """Re-raises, counts into a metric, or delegates to a failure-
+        bookkeeping helper (``record_failure``, ``*_failed``,
+        ``*requeue*``, ``*fallback*`` — the dispatcher-ladder
+        convention: one helper owns breaker + counter updates for a
+        whole tier's failure paths)."""
+        for stmt in body:
+            for node in ast.walk(stmt):
+                if isinstance(node, ast.Raise):
+                    return True
+                if isinstance(node, ast.Call):
+                    last = call_name(node).rsplit(".", 1)[-1]
+                    if last in ("inc", "observe", "record_failure"):
+                        return True
+                    # .set() counts only on a metric family (ALL-CAPS
+                    # module global or a .labels(...) child) — an
+                    # asyncio.Event.set() records nothing
+                    if last == "set" and \
+                            isinstance(node.func, ast.Attribute) and \
+                            self._metric_receiver(node.func.value):
+                        return True
+                    if last.endswith(("_failed", "_failure")) or \
+                            "requeue" in last or "fallback" in last:
+                        return True
+        return False
+
+    @staticmethod
+    def _metric_receiver(recv: ast.AST) -> bool:
+        if isinstance(recv, ast.Call):
+            return call_name(recv).rsplit(".", 1)[-1] == "labels"
+        last = dotted(recv).rsplit(".", 1)[-1]
+        return bool(last) and last == last.upper() and \
+            any(c.isalpha() for c in last)

@@ -1,0 +1,41 @@
+"""Checker: silent broad exception swallows (package-wide).
+
+Migrated from the ad-hoc AST lint that lived in
+``tests/test_observability.py`` (ISSUE 3 satellite), which only swept
+a hand-maintained directory list.  bmlint sweeps the whole package and
+``tools/``: a broad handler (bare ``except:``, ``except Exception`` /
+``BaseException``) whose body is ONLY ``pass``/``...``/``continue``
+silently destroys the error — it must log, count a metric, re-raise,
+or return something.
+
+Severity tiers: "error" in the hot/critical packages
+(:data:`tools.bmlint.core.CRITICAL_DIRS`), "warning" in UI shells,
+plugins and gateways — both gate against the committed baseline.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import FileCtx, Finding, is_broad_except, is_silent_stmt
+
+
+class SilentSwallowChecker:
+    name = "swallow"
+    rules = ("silent-swallow",)
+
+    def check_file(self, ctx: FileCtx):
+        out: list[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ExceptHandler) and \
+                    is_broad_except(node.type) and \
+                    all(is_silent_stmt(s) for s in node.body):
+                out.append(ctx.finding(
+                    "silent-swallow", node,
+                    "broad except swallows the error silently — log it, "
+                    "count it into resilience_errors_total, or re-raise "
+                    "(docs/resilience.md)"))
+        return out
+
+    def finish(self):
+        return ()

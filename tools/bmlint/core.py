@@ -1,0 +1,255 @@
+"""bmlint engine: file contexts, findings, suppressions, the run loop.
+
+The analyzer is deliberately zero-dependency (stdlib ``ast`` only) so
+``make lint`` runs on the bare CI image.  A checker is a class with
+
+- ``name`` — checker id for ``--select`` style filtering,
+- ``rules`` — the rule ids it may emit,
+- ``check_file(ctx)`` — per-file findings,
+- ``finish()`` — project-wide findings after every file was seen
+  (cross-file rules like chaos-site coverage).
+
+Findings carry a line-number-independent fingerprint (``key``) so the
+committed baseline survives unrelated edits above a finding; see
+:mod:`tools.bmlint.baseline` for the gate semantics.
+
+Suppression syntax (documented in docs/static_analysis.md): a comment
+``# bmlint: allow(rule-a, rule-b)`` on the offending line or the line
+directly above silences those rules for that line; ``allow(*)``
+silences everything.  Suppressions are counted and reported so a tree
+full of them is visible in review.
+"""
+
+from __future__ import annotations
+
+import ast
+import hashlib
+import re
+from dataclasses import dataclass, field
+
+#: directories whose findings default to severity "error"; the rest of
+#: the package (UI shells, plugins, gateways) reports "warning" — both
+#: gate against the baseline, the tier only orders triage
+CRITICAL_DIRS = frozenset({
+    "pow", "network", "sync", "crypto", "storage", "workers",
+    "observability", "resilience", "api", "ops", "parallel", "tools",
+})
+
+_ALLOW_RE = re.compile(r"#\s*bmlint:\s*allow\(([^)]*)\)")
+
+
+@dataclass
+class Finding:
+    rule: str
+    path: str              # repo-relative, forward slashes
+    line: int
+    col: int
+    message: str
+    severity: str = "error"
+    scope: str = "<module>"   # enclosing function qualname
+    key: str = ""             # stable fingerprint, set by assign_keys
+
+    def location(self) -> str:
+        return "%s:%d" % (self.path, self.line)
+
+    def as_dict(self) -> dict:
+        return {"rule": self.rule, "file": self.path, "line": self.line,
+                "col": self.col, "severity": self.severity,
+                "scope": self.scope, "message": self.message,
+                "key": self.key}
+
+
+def parse_suppressions(source: str) -> dict[int, set[str]]:
+    """``{lineno: {rule, ...}}`` for every ``# bmlint: allow(...)``."""
+    out: dict[int, set[str]] = {}
+    for i, line in enumerate(source.splitlines(), start=1):
+        m = _ALLOW_RE.search(line)
+        if m:
+            rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+            out[i] = rules
+    return out
+
+
+class FileCtx:
+    """One parsed source file plus the helpers checkers share."""
+
+    def __init__(self, relpath: str, source: str):
+        self.relpath = relpath.replace("\\", "/")
+        self.source = source
+        self.tree = ast.parse(source, filename=self.relpath)
+        self.suppressions = parse_suppressions(source)
+        self._scopes: dict[int, str] = {}
+        self._index_scopes()
+
+    # -- layout helpers ------------------------------------------------------
+
+    @property
+    def top_dir(self) -> str:
+        """``pybitmessage_tpu/pow/x.py -> "pow"``; ``tools/x.py ->
+        "tools"``; package-root modules -> ""."""
+        parts = self.relpath.split("/")
+        if parts[0] == "tools":
+            return "tools"
+        if len(parts) >= 3 and parts[0] == "pybitmessage_tpu":
+            return parts[1]
+        return ""
+
+    @property
+    def default_severity(self) -> str:
+        return "error" if self.top_dir in CRITICAL_DIRS else "warning"
+
+    # -- scope naming --------------------------------------------------------
+
+    def _index_scopes(self) -> None:
+        def walk(node, prefix):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef,
+                                      ast.ClassDef)):
+                    qual = (prefix + "." + child.name) if prefix \
+                        else child.name
+                    # recurse FIRST so inner scopes claim their lines;
+                    # setdefault then fills the remainder — innermost
+                    # wins, giving the true enclosing qualname
+                    walk(child, qual)
+                    for sub in ast.walk(child):
+                        if hasattr(sub, "lineno"):
+                            self._scopes.setdefault(sub.lineno, qual)
+                else:
+                    walk(child, prefix)
+        walk(self.tree, "")
+
+    def scope_of(self, node: ast.AST) -> str:
+        return self._scopes.get(getattr(node, "lineno", 0), "<module>")
+
+    # -- finding factory -----------------------------------------------------
+
+    def finding(self, rule: str, node: ast.AST, message: str,
+                severity: str | None = None) -> Finding:
+        return Finding(rule=rule, path=self.relpath,
+                       line=getattr(node, "lineno", 0),
+                       col=getattr(node, "col_offset", 0),
+                       message=message,
+                       severity=severity or self.default_severity,
+                       scope=self.scope_of(node))
+
+    def is_suppressed(self, f: Finding) -> bool:
+        for line in (f.line, f.line - 1):
+            rules = self.suppressions.get(line)
+            if rules and (f.rule in rules or "*" in rules):
+                return True
+        return False
+
+
+# ---------------------------------------------------------------------------
+# AST helpers shared by the checkers
+# ---------------------------------------------------------------------------
+
+
+def dotted(node: ast.AST) -> str:
+    """``a.b.c`` for a Name/Attribute chain; "" when not a chain."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    elif parts:
+        parts.append("<expr>")
+    else:
+        return ""
+    return ".".join(reversed(parts))
+
+
+def call_name(call: ast.Call) -> str:
+    return dotted(call.func)
+
+
+def str_const(node: ast.AST) -> str | None:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def is_broad_except(expr: ast.AST | None) -> bool:
+    """bare ``except:`` / ``except Exception`` / ``BaseException``
+    (also inside tuples)."""
+    if expr is None:
+        return True
+    if isinstance(expr, ast.Tuple):
+        return any(is_broad_except(e) for e in expr.elts)
+    return isinstance(expr, ast.Name) and \
+        expr.id in ("Exception", "BaseException")
+
+
+def is_silent_stmt(stmt: ast.stmt) -> bool:
+    if isinstance(stmt, (ast.Pass, ast.Continue)):
+        return True
+    return isinstance(stmt, ast.Expr) and \
+        isinstance(stmt.value, ast.Constant)
+
+
+# ---------------------------------------------------------------------------
+# the run loop
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class RunResult:
+    findings: list[Finding] = field(default_factory=list)
+    suppressed: list[Finding] = field(default_factory=list)
+    files: int = 0
+
+
+def assign_keys(findings: list[Finding]) -> None:
+    """Stable, line-independent fingerprints.
+
+    ``rule:path:scope:<sha1(message)[:8]>:<n>`` — ``n`` disambiguates
+    identical findings inside one scope by source order, so inserting
+    code above a finding never invalidates the baseline but a genuine
+    second occurrence is a new key."""
+    seen: dict[tuple, int] = {}
+    for f in sorted(findings, key=lambda f: (f.path, f.line, f.col)):
+        digest = hashlib.sha1(f.message.encode()).hexdigest()[:8]
+        base = (f.rule, f.path, f.scope, digest)
+        n = seen.get(base, 0)
+        seen[base] = n + 1
+        f.key = "%s:%s:%s:%s:%d" % (f.rule, f.path, f.scope, digest, n)
+
+
+def run_checkers(files: list[tuple[str, str]],
+                 checkers: list | None = None) -> RunResult:
+    """Lint ``[(relpath, source), ...]`` entirely in memory.
+
+    Checker instances are fresh per run (their ``finish`` state is
+    run-local).  Unparseable files yield a ``parse-error`` finding
+    instead of aborting the sweep."""
+    if checkers is None:
+        from .checkers import default_checkers
+        checkers = default_checkers()
+    result = RunResult()
+    for relpath, source in files:
+        result.files += 1
+        if source is None:      # collect_files: undecodable bytes
+            result.findings.append(Finding(
+                rule="parse-error", path=relpath, line=0, col=0,
+                message="file is not valid UTF-8"))
+            continue
+        try:
+            ctx = FileCtx(relpath, source)
+        except SyntaxError as exc:
+            result.findings.append(Finding(
+                rule="parse-error", path=relpath,
+                line=exc.lineno or 0, col=exc.offset or 0,
+                message="file does not parse: %s" % exc.msg))
+            continue
+        for checker in checkers:
+            for f in checker.check_file(ctx):
+                (result.suppressed if ctx.is_suppressed(f)
+                 else result.findings).append(f)
+    for checker in checkers:
+        result.findings.extend(checker.finish())
+    assign_keys(result.findings)
+    assign_keys(result.suppressed)
+    result.findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return result

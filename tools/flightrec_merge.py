@@ -23,6 +23,10 @@ Accepted inputs, auto-detected per file:
 Output: the combined timeline, oldest first, each event annotated
 with its source ``node`` and skew-normalized ``t_norm`` — as an
 aligned text table, or one JSON document with ``--json``.
+
+Like everything under ``tools/``, this script is swept by the bmlint
+gate (``make lint``, docs/static_analysis.md) at the package's own
+severity tier — swallow/naming/discipline rules included.
 """
 
 from __future__ import annotations
