@@ -114,6 +114,9 @@ async def run(args) -> int:
                 tls_enabled=settings.getbool("tls"),
                 udp_enabled=settings.getbool("udp") and not args.no_listen,
                 inventory_backend=settings.get("inventorystorage"),
+                slab_max_bytes=settings.getint("slabmaxbytes"),
+                slab_hot_bytes=settings.getint("slabhotbytes"),
+                slab_bucket_seconds=settings.getint("slabbucketseconds"),
                 pow_window=settings.getfloat("powbatchwindow"),
                 sync_enabled=settings.getbool("syncenabled"),
                 wiretrace_enabled=settings.getbool("wiretrace"),

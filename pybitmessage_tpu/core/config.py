@@ -69,7 +69,14 @@ DEFAULTS: dict[str, str] = {
     "namecoinrpcport": "8336",
     "namecoinrpcuser": "",
     "namecoinrpcpassword": "",
-    "inventorystorage": "sqlite",    # sqlite | filesystem
+    "inventorystorage": "sqlite",    # sqlite | filesystem | slab
+    # -- sharded slab object store (docs/storage.md) --
+    "slabmaxbytes": "4194304",       # slab seal threshold, bytes
+    "slabhotbytes": "8388608",       # pinned hot-set payload budget,
+                                     # bytes (serves sync push/getdata
+                                     # without disk reads)
+    "slabbucketseconds": "3600",     # expiry bucket width — TTL purge
+                                     # drops whole buckets of slabs
     "userlocale": "system",          # UI language persisted for all
                                      # attached frontends (reference:
                                      # languagebox.py userlocale)
@@ -230,7 +237,10 @@ VALIDATORS: dict[str, Callable[[str], bool]] = {
     "upnp": _validate_bool,
     "tls": _validate_bool,
     "apivariant": lambda v: v in ("json", "xml"),
-    "inventorystorage": lambda v: v in ("sqlite", "filesystem"),
+    "inventorystorage": lambda v: v in ("sqlite", "filesystem", "slab"),
+    "slabmaxbytes": _validate_int_range(1 << 12, 1 << 30),
+    "slabhotbytes": _validate_int_range(0, 1 << 32),
+    "slabbucketseconds": _validate_int_range(1, 28 * 24 * 3600),
     # besides the literal protocols, any identifier names a proxyconfig
     # plugin (reference socksproxytype convention, e.g. "stem")
     "sockstype": lambda v: v.replace("_", "").isalnum() or v == "none",

@@ -41,6 +41,9 @@ class Node:
                  stream: int = 1, test_mode: bool = False,
                  tls_enabled: bool = True, udp_enabled: bool = False,
                  inventory_backend: str = "sqlite",
+                 slab_max_bytes: int = 4 << 20,
+                 slab_hot_bytes: int = 8 << 20,
+                 slab_bucket_seconds: int = 3600,
                  pow_window: float | None = None,
                  sync_enabled: bool = True,
                  wiretrace_enabled: bool = True,
@@ -67,6 +70,16 @@ class Node:
             # the 'inventory.storage' config alternative)
             from ..storage.fs_inventory import FilesystemInventory
             self.inventory = FilesystemInventory(self.data_dir / "inventory")
+        elif inventory_backend == "slab":
+            # sharded slab store (docs/storage.md): the retention-scale
+            # backend — RAM metadata index, whole-slab TTL drops,
+            # pinned hot set; memory-resident without a data_dir
+            from ..storage.slabstore import SlabStore
+            self.inventory = SlabStore(
+                self.data_dir / "slabs" if self.data_dir else None,
+                slab_max_bytes=slab_max_bytes,
+                hot_bytes=slab_hot_bytes,
+                bucket_seconds=slab_bucket_seconds)
         else:
             self.inventory = Inventory(self.db)
         self.keystore = KeyStore(keys_path)

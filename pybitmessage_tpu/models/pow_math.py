@@ -47,8 +47,12 @@ def pow_initial_hash(object_bytes_sans_nonce: bytes) -> bytes:
 
 
 def pow_value(object_bytes: bytes) -> int:
-    """The trial value of a full object (nonce || payload)."""
-    trial = double_sha512(object_bytes[:8] + sha512(object_bytes[8:]))
+    """The trial value of a full object (nonce || payload).
+
+    Accepts any buffer (the zero-copy receive path hands in
+    memoryviews over pooled buffers; ``bytes()`` of the 8-byte nonce
+    slice is the only copy)."""
+    trial = double_sha512(bytes(object_bytes[:8]) + sha512(object_bytes[8:]))
     return int.from_bytes(trial[:8], "big")
 
 

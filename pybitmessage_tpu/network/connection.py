@@ -37,6 +37,7 @@ from ..resilience import inject
 from ..resilience.policy import ERRORS
 from ..utils.hashes import inventory_hash
 from ..utils.varint import VarintError
+from .bufpool import COPIED_MATERIALIZE, RECV_POOL, PooledBuffer
 from .messages import (
     AddrEntry, MessageError, VersionPayload, append_trace_ctx,
     decode_addr, decode_inv, encode_addr, encode_error, encode_host,
@@ -197,29 +198,43 @@ class BMConnection:
 
     # -- framing -------------------------------------------------------------
 
-    async def _read_throttled(self, n: int) -> bytes:
-        """Read ``n`` bytes consuming download tokens BEFORE each
-        chunk, so a burst cannot outrun ``maxdownloadrate`` (the
-        reference throttles at recv granularity,
-        asyncore_pollchoose.py:109-130; r3 consumed the bucket only
-        after the payload was already buffered).  While this coroutine
+    async def _read_chunked(self, n: int, sink) -> None:
+        """THE throttled read loop: consume download tokens BEFORE
+        each 32 KiB chunk, so a burst cannot outrun
+        ``maxdownloadrate`` (the reference throttles at recv
+        granularity, asyncore_pollchoose.py:109-130; r3 consumed the
+        bucket only after the payload was already buffered), and hand
+        each chunk to ``sink(offset, chunk)``.  While this coroutine
         sits in the bucket, the stream's flow control back-pressures
-        the peer once the read buffer fills."""
-        if n == 0:
-            return b""
+        the peer once the read buffer fills.  Both read paths share
+        this loop — the throttle/activity semantics cannot drift."""
         bucket = self.ctx.download_bucket
-        chunks = []
-        remaining = n
-        while remaining:
-            take = min(remaining, 32768)
+        offset = 0
+        while offset < n:
+            take = min(n - offset, 32768)
             await bucket.consume(take)
-            chunks.append(await self.reader.readexactly(take))
-            remaining -= take
+            sink(offset, await self.reader.readexactly(take))
+            offset += take
             # a paced transfer IS activity: without this a low rate
             # limit lets the inactivity reaper close a connection
             # mid-payload while bytes are still flowing
             self.last_activity = time.time()
+
+    async def _read_throttled(self, n: int) -> bytes:
+        """Read ``n`` bytes as ``bytes`` (header/resync-sized only —
+        payloads go through :meth:`_read_payload_into`)."""
+        if n == 0:
+            return b""
+        chunks: list[bytes] = []
+        await self._read_chunked(n, lambda off, chunk: chunks.append(chunk))
         return chunks[0] if len(chunks) == 1 else b"".join(chunks)
+
+    async def _read_payload_into(self, buf: PooledBuffer, n: int) -> None:
+        """Fill a pooled payload buffer ``readinto``-style: each socket
+        chunk lands at its final offset (the ONE fill copy, counted
+        into ``ingest_bytes_copied_total{stage="fill"}``) — no chunk
+        list, no join, no per-packet ``bytes`` churn."""
+        await self._read_chunked(n, buf.write_at)
 
     async def _read_packet(self) -> None:
         # ingest backpressure (docs/ingest.md): while the validated-
@@ -242,17 +257,34 @@ class BMConnection:
         if length > MAX_MESSAGE_SIZE:
             PACKET_ERRORS.inc()
             raise ConnectionClosed("oversize payload")
-        payload = await self._read_throttled(length)
-        if not verify_payload(payload, checksum):
-            PACKET_ERRORS.inc()
-            raise ConnectionClosed("bad checksum")
-        PACKETS_RX.inc()
-        self.last_activity = time.time()
-        handler = getattr(self, "cmd_" + command, None)
-        if handler is None:
-            logger.debug("unimplemented command %r", command)
-            return
-        await handler(payload)
+        # zero-copy framing (docs/ingest.md): the payload fills a
+        # pooled buffer; checksum verify, object-header parse, PoW
+        # check and duplicate detection all run over memoryviews of
+        # it.  Only a NEW object (or a non-object command handler)
+        # materializes stable bytes — duplicate floods cost the fill
+        # copy alone.
+        buf = RECV_POOL.acquire(length)
+        try:
+            await self._read_payload_into(buf, length)
+            view = buf.view()
+            if not verify_payload(view, checksum):
+                PACKET_ERRORS.inc()
+                raise ConnectionClosed("bad checksum")
+            PACKETS_RX.inc()
+            self.last_activity = time.time()
+            if command == "object":
+                await self.cmd_object(view, buf=buf)
+                return
+            if command == "tobject":
+                await self.cmd_tobject(view, buf=buf)
+                return
+            handler = getattr(self, "cmd_" + command, None)
+            if handler is None:
+                logger.debug("unimplemented command %r", command)
+                return
+            await handler(buf.materialize())
+        finally:
+            buf.release()
 
     async def send_packet(self, command: str, payload: bytes = b"") -> None:
         inject("net.send")
@@ -550,7 +582,8 @@ class BMConnection:
         TRACE_CTX_SENT.labels(command="tobject").inc()
         await self.send_packet("tobject", ctx.encode() + payload)
 
-    async def cmd_tobject(self, payload: bytes) -> None:
+    async def cmd_tobject(self, payload: bytes, *,
+                          buf: PooledBuffer | None = None) -> None:
         """A trace-carrying object push.  Only trace-negotiated peers
         send these; from anyone else the command is ignored like any
         unknown command would be (the object will arrive again through
@@ -561,20 +594,27 @@ class BMConnection:
                          self.host)
             return
         try:
-            ctx = TraceContext.decode(payload[:TRACE_CTX_LEN])
+            ctx = TraceContext.decode(bytes(payload[:TRACE_CTX_LEN]))
         except ValueError:
             TRACE_CTX_INVALID.inc()
             return
         TRACE_CTX_RECEIVED.labels(command="tobject").inc()
         self.skew.observe(ctx.sent_at)
-        await self._handle_object(payload[TRACE_CTX_LEN:], trace_ctx=ctx)
+        await self._handle_object(payload[TRACE_CTX_LEN:], trace_ctx=ctx,
+                                  buf=buf)
 
-    async def cmd_object(self, payload: bytes) -> None:
+    async def cmd_object(self, payload: bytes, *,
+                         buf: PooledBuffer | None = None) -> None:
         self._require_established()
-        await self._handle_object(payload)
+        await self._handle_object(payload, buf=buf)
 
-    async def _handle_object(self, payload: bytes,
-                             trace_ctx: TraceContext | None = None) -> None:
+    async def _handle_object(self, payload,
+                             trace_ctx: TraceContext | None = None,
+                             buf: PooledBuffer | None = None) -> None:
+        """``payload`` is either stable ``bytes`` (legacy callers,
+        tests) or a memoryview over ``buf`` — every check below runs
+        on either without copying; only :meth:`_accept_object`
+        materializes, and only for objects that are actually new."""
         try:
             header = ObjectHeader.parse(payload)
             check_by_type(header.object_type, header.version, len(payload))
@@ -590,10 +630,14 @@ class BMConnection:
             # checks coalesce into fused device batches in the
             # verifier's drain task (SURVEY §7.7).  Awaiting the check
             # inline would cap ingest at one object per device
-            # round-trip and starve the batching entirely.
+            # round-trip and starve the batching entirely.  The pooled
+            # buffer rides along retained: the view stays valid until
+            # the verify task settles and releases it.
             await self._verify_sem.acquire()
+            if buf is not None:
+                buf.retain()
             task = asyncio.create_task(
-                self._verify_and_accept(header, payload, trace_ctx))
+                self._verify_and_accept(header, payload, trace_ctx, buf))
             self._verify_tasks.add(task)
             task.add_done_callback(self._verify_task_done)
         else:
@@ -616,16 +660,21 @@ class BMConnection:
             logger.error("object acceptance failed on %s:%s",
                          self.host, self.port, exc_info=exc)
 
-    async def _verify_and_accept(self, header, payload: bytes,
-                                 trace_ctx=None) -> None:
-        ok = await self.ctx.pow_verifier.check(payload)
-        if not ok:
-            logger.debug("insufficient PoW from %s", self.host)
-            await self.close()
-            return
-        self._accept_object(header, payload, trace_ctx)
+    async def _verify_and_accept(self, header, payload,
+                                 trace_ctx=None,
+                                 buf: PooledBuffer | None = None) -> None:
+        try:
+            ok = await self.ctx.pow_verifier.check(payload)
+            if not ok:
+                logger.debug("insufficient PoW from %s", self.host)
+                await self.close()
+                return
+            self._accept_object(header, payload, trace_ctx)
+        finally:
+            if buf is not None:
+                buf.release()
 
-    def _accept_object(self, header, payload: bytes,
+    def _accept_object(self, header, payload,
                        trace_ctx=None) -> None:
         h = inventory_hash(payload)
         if trace_ctx is not None:
@@ -638,6 +687,12 @@ class BMConnection:
         self.ctx.global_tracker.received(h)
         if h in self.ctx.inventory:
             return
+        # new object: the ONE materialize copy past the buffer fill —
+        # shared by the inventory row, the hot set and the processor
+        # queue (duplicates above never reach this line)
+        if not isinstance(payload, (bytes, bytearray)):
+            COPIED_MATERIALIZE.inc(len(payload))
+            payload = bytes(payload)
         # getpubkey/pubkey carry a tag from v4; broadcast only from v5
         # (a v4 broadcast's first 32 bytes are ciphertext, not a tag)
         tagged = (header.object_type in (0, 1) and header.version >= 4) or \

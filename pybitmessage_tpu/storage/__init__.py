@@ -13,3 +13,4 @@ as a lock, not a dedicated thread + queue pair.
 from .db import Database  # noqa: F401
 from .inventory import Inventory  # noqa: F401
 from .knownnodes import KnownNodes, Peer  # noqa: F401
+from .slabstore import SlabStore  # noqa: F401

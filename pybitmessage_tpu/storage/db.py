@@ -20,7 +20,7 @@ from typing import Any, Iterable, Sequence
 
 logger = logging.getLogger("pybitmessage_tpu.storage")
 
-SCHEMA_VERSION = 11
+SCHEMA_VERSION = 12
 
 #: the version ``_SCHEMA`` below creates; _SCHEMA is frozen here —
 #: every later schema change goes into MIGRATIONS, which fresh and
@@ -37,6 +37,17 @@ BASELINE_VERSION = 11
 #: table for reference-parity introspection).
 MIGRATIONS: dict[int, tuple[str, ...]] = {
     BASELINE_VERSION: (),   # baseline: reference-v11-equivalent schema
+    # v12: cover the two hot inventory scans.  At retention scale the
+    # catch-up path (unexpired_hashes_by_stream: WHERE streamnumber=?
+    # AND expirestime>?) and the TTL purge (clean: WHERE
+    # expirestime<?) were full-table scans — the UNIQUE(hash) index
+    # helps neither.
+    12: (
+        "CREATE INDEX IF NOT EXISTS idx_inventory_stream_expires"
+        " ON inventory(streamnumber, expirestime)",
+        "CREATE INDEX IF NOT EXISTS idx_inventory_expires"
+        " ON inventory(expirestime)",
+    ),
 }
 
 _SCHEMA = """

@@ -53,6 +53,13 @@ class Inventory:
         #: in step with add/clean — reconciliation rounds read it
         #: instead of rescanning the inventory table
         self._digest = None
+        #: cached SQL row count, maintained incrementally — __len__
+        #: used to run SELECT count(*) per call (and ITEMS.set(len())
+        #: re-ran it every clean()), which at 10M rows is a table scan
+        #: on the hot path.  One count at startup, then flush() adds
+        #: and clean() subtracts its DELETE rowcount.
+        self._sql_count = self._db.query(
+            "SELECT count(*) FROM inventory")[0][0]
         # process-wide gauge: the most recently constructed/cleaned
         # Inventory owns the reading (one live inventory per daemon)
         ITEMS.set(len(self))
@@ -109,8 +116,7 @@ class Inventory:
 
     def __len__(self) -> int:
         with self._lock:
-            n = self._db.query("SELECT count(*) FROM inventory")[0][0]
-            return len(self._pending) + n
+            return len(self._pending) + self._sql_count
 
     def add(self, hash_: bytes, type_: int, stream: int, payload: bytes,
             expires: int, tag: bytes = b"") -> None:
@@ -144,19 +150,37 @@ class Inventory:
 
     def flush(self) -> None:
         with self._lock:
+            if not self._pending:
+                FLUSHES.inc()
+                return
+            # maintain the cached SQL count exactly: a pending hash
+            # already present in SQL REPLACEs its row instead of
+            # adding one (chunked probe — pending is small, and only
+            # hashes SQL could actually hold are worth asking about)
+            pending = list(self._pending.keys())
+            dups = 0
+            for i in range(0, len(pending), 500):
+                chunk = pending[i:i + 500]
+                dups += self._db.query(
+                    "SELECT count(*) FROM inventory WHERE hash IN (%s)"
+                    % ",".join("?" * len(chunk)), chunk)[0][0]
             self._db.executemany(
                 "INSERT INTO inventory VALUES (?, ?, ?, ?, ?, ?)",
                 [(h, v.type, v.stream, v.payload, v.expires, v.tag)
                  for h, v in self._pending.items()])
+            self._sql_count += len(self._pending) - dups
             self._pending.clear()
             FLUSHES.inc()
 
     def clean(self) -> None:
         """Purge objects >3h expired; rebuild the existence cache."""
         with self._lock:
-            self._db.execute(
+            deleted = self._db.execute(
                 "DELETE FROM inventory WHERE expirestime<?",
                 (int(time.time()) - EXPIRES_GRACE,))
+            # the DELETE's rowcount keeps the cached count exact —
+            # no SELECT count(*) rescan per cleanup cycle
+            self._sql_count = max(self._sql_count - max(deleted, 0), 0)
             self._known.clear()
             for h, v in self._pending.items():
                 self._known[h] = v.stream

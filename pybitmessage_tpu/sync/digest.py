@@ -8,11 +8,13 @@ announce nothing; only mismatched buckets fall back to explicit inv
 lists.  Two already-synced nodes meet for a few hundred bytes instead
 of megabytes.
 
-The digest is maintained *incrementally* by ``storage/inventory.py``
-(``attach_digest``): ``add`` folds the new hash in, ``clean`` unfolds
-expired ones — XOR makes removal exact — so reconciliation rounds and
-catch-ups never rescan the inventory table (regression-guarded in
-tests/test_sync.py).
+The digest is maintained *incrementally* by every inventory backend's
+``attach_digest`` (``storage/inventory.py`` seeds it with its one-ever
+SQL scan; ``storage/slabstore.py`` seeds it straight from its RAM
+metadata index — no storage touch at all): ``add`` folds the new hash
+in, ``clean`` unfolds expired ones — XOR makes removal exact — so
+reconciliation rounds and catch-ups never rescan the inventory table
+(regression-guarded in tests/test_sync.py).
 
 Digest short IDs use a FIXED zero salt: the summaries are maintained
 once per node, not per session, so every peer must bucket and mix

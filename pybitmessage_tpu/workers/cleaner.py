@@ -54,13 +54,18 @@ class Cleaner:
                 logger.exception("cleaner cycle failed")
 
     async def run_once(self) -> None:
-        self.inventory.flush()
+        # storage work stays off the event loop (both backends take
+        # their own locks, so a worker thread is safe): at 10M-object
+        # retention a flush/TTL-purge cycle is hundreds of ms — inline
+        # it would stall every connection read loop (the <50 ms
+        # loop-lag bar rides through compaction in bench ingest_storm)
+        await asyncio.to_thread(self.inventory.flush)
         if time.time() - self._last_deep_clean >= DEEP_CLEAN_INTERVAL:
             self._last_deep_clean = time.time()
-            self.inventory.clean()
-            purged = self.store.purge_stale_pubkeys()
+            await asyncio.to_thread(self.inventory.clean)
+            purged = await asyncio.to_thread(self.store.purge_stale_pubkeys)
             dropped = self.knownnodes.cleanup()
-            self.knownnodes.save()
+            await asyncio.to_thread(self.knownnodes.save)
             if self.pool is not None:
                 self.pool.ctx.global_tracker.expire()
             if self.sender is not None:

@@ -1252,13 +1252,15 @@ def _spy_object_commands(conn):
     orig_tobj = conn.cmd_tobject
     orig_obj = conn.cmd_object
 
-    async def spy_tobj(payload):
-        seen["tobject"].append(payload)
-        await orig_tobj(payload)
+    # snapshot to bytes: the zero-copy read loop hands these handlers
+    # memoryviews over a pooled buffer that is reused after the packet
+    async def spy_tobj(payload, **kw):
+        seen["tobject"].append(bytes(payload))
+        await orig_tobj(payload, **kw)
 
-    async def spy_obj(payload):
-        seen["object"].append(payload)
-        await orig_obj(payload)
+    async def spy_obj(payload, **kw):
+        seen["object"].append(bytes(payload))
+        await orig_obj(payload, **kw)
 
     conn.cmd_tobject = spy_tobj
     conn.cmd_object = spy_obj

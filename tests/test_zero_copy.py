@@ -1,0 +1,229 @@
+"""Zero-copy packet path (ISSUE 11 tentpole a).
+
+Drives a real ``BMConnection`` read loop over an in-memory
+``StreamReader`` so the pooled-buffer framing is exercised exactly as
+the socket path runs it: header resync, checksum verify over views,
+duplicate detection before any materialize, buffer retention across
+the async PoW-verify pipeline, and the ``ingest_bytes_copied_total``
+accounting the bench bands are built on.
+"""
+
+import asyncio
+import os
+import time
+
+import pytest
+
+from pybitmessage_tpu.models.objects import serialize_object
+from pybitmessage_tpu.models.packet import pack_packet
+from pybitmessage_tpu.models.pow_math import pow_target
+from pybitmessage_tpu.network.bufpool import BufferPool, RECV_POOL
+from pybitmessage_tpu.network.connection import (
+    BMConnection, ConnectionClosed,
+)
+from pybitmessage_tpu.network.pool import NodeContext
+from pybitmessage_tpu.observability import REGISTRY
+from pybitmessage_tpu.pow.dispatcher import python_solve
+from pybitmessage_tpu.storage import SlabStore
+from pybitmessage_tpu.storage.knownnodes import KnownNodes
+from pybitmessage_tpu.utils.hashes import inventory_hash, sha512
+
+
+class _CaptureWriter:
+    def __init__(self):
+        self.data = bytearray()
+        self.closed = False
+
+    def write(self, b):
+        self.data += b
+
+    async def drain(self):
+        pass
+
+    def close(self):
+        self.closed = True
+
+    async def wait_closed(self):
+        pass
+
+    def get_extra_info(self, *a, **k):
+        return None
+
+
+class _StubPool:
+    def __init__(self, ctx):
+        self.ctx = ctx
+        self.reconciler = None
+        self.received = []
+
+    def object_received(self, h, header, payload, source):
+        self.received.append((h, bytes(payload)))
+
+    def connection_closed(self, conn):
+        pass
+
+    def connection_established(self, conn):
+        pass
+
+    def established(self):
+        return []
+
+
+def _make_conn(verifier=None):
+    ctx = NodeContext(inventory=SlabStore(None),
+                      knownnodes=KnownNodes(None),
+                      pow_ntpb=1, pow_extra=1)
+    ctx.pow_verifier = verifier
+    pool = _StubPool(ctx)
+    reader = asyncio.StreamReader()
+    writer = _CaptureWriter()
+    conn = BMConnection(pool, reader, writer, outbound=False,
+                        host="test", port=1)
+    conn.fully_established = True
+    conn.remote_protocol = 3
+    return conn, pool, reader, writer
+
+
+def _object_payload(i: int, ttl: int = 3600, size: int = 80) -> bytes:
+    expires = int(time.time()) + ttl
+    sans_nonce = serialize_object(expires, 2, 1, 1,
+                                  b"%04d" % i + os.urandom(size))[8:]
+    target = pow_target(len(sans_nonce) + 8, ttl, 1, 1, clamp=False)
+    nonce, _ = python_solve(sha512(sans_nonce), target)
+    return nonce.to_bytes(8, "big") + sans_nonce
+
+
+def _copied(stage: str) -> float:
+    return REGISTRY.sample("ingest_bytes_copied_total",
+                           {"stage": stage}) or 0.0
+
+
+def test_buffer_pool_reuse_and_refcount():
+    pool = BufferPool(cap=4)
+    buf = pool.acquire(100)
+    backing = buf._data
+    buf.write_at(0, b"x" * 100)
+    assert bytes(buf.view()) == b"x" * 100
+    buf.retain()                 # second owner (a verify task, say)
+    buf.release()
+    assert pool.parked() == 0    # still retained — not parked
+    buf.release()
+    assert pool.parked() == 1
+    buf2 = pool.acquire(50)      # reuses the parked backing store
+    assert buf2._data is backing
+    assert pool.parked() == 0
+
+
+def test_buffer_pool_cap_bounds_idle_memory():
+    pool = BufferPool(cap=2)
+    bufs = [pool.acquire(10) for _ in range(5)]
+    for b in bufs:
+        b.release()
+    assert pool.parked() == 2
+
+
+def test_buffer_pool_prefers_large_buffers_when_full():
+    """A full free list must not let small-command buffers pin the
+    pool: a larger buffer coming back evicts the smallest parked one,
+    so object-sized payloads keep hitting."""
+    pool = BufferPool(cap=2)
+    small = [pool.acquire(10) for _ in range(2)]
+    big = pool.acquire(100_000)
+    big_backing = big._data
+    for b in small:
+        b.release()
+    assert pool.parked() == 2      # full of 4 KiB buffers
+    big.release()                  # evicts one small buffer
+    reacquired = pool.acquire(100_000)
+    assert reacquired._data is big_backing
+
+
+def test_object_frames_duplicates_never_materialize():
+    """The headline accounting: every frame pays the fill copy, but
+    only NEW objects pay the materialize — a duplicate flood is
+    recognized over the pooled view and dropped copy-free."""
+    async def run():
+        conn, pool, reader, writer = _make_conn()
+        payloads = [_object_payload(i) for i in range(8)]
+        frames = [pack_packet("object", p) for p in payloads]
+        fill0, mat0 = _copied("fill"), _copied("materialize")
+        # each object arrives 3x (every object reaches a node from
+        # ~sqrt(N) peers in a flooding overlay)
+        total_payload = 0
+        for rep in range(3):
+            for f, p in zip(frames, payloads):
+                reader.feed_data(f)
+                total_payload += len(p)
+                await conn._read_packet()
+        assert len(pool.received) == 8
+        assert len(conn.ctx.inventory) == 8
+        for p in payloads:
+            assert inventory_hash(p) in conn.ctx.inventory
+        unique_payload = sum(len(p) for p in payloads)
+        assert _copied("fill") - fill0 == total_payload
+        assert _copied("materialize") - mat0 == unique_payload
+    asyncio.run(run())
+
+
+def test_object_payload_bytes_identical_through_views():
+    async def run():
+        conn, pool, reader, writer = _make_conn()
+        p = _object_payload(99, size=5000)   # multi-chunk fill
+        reader.feed_data(pack_packet("object", p))
+        await conn._read_packet()
+        h = inventory_hash(p)
+        assert conn.ctx.inventory[h].payload == p
+        assert pool.received == [(h, p)]
+    asyncio.run(run())
+
+
+def test_verify_pipeline_retains_pooled_buffer():
+    """With the batched PoW verifier attached, the view crosses an
+    await boundary inside a verify task — the retained buffer must
+    stay intact until the task settles."""
+    from pybitmessage_tpu.pow.verify_service import BatchVerifier
+
+    async def run():
+        verifier = BatchVerifier(ntpb=1, extra=1, clamp=False)
+        # host-path checks: the framing contract under test is buffer
+        # retention across the await, not the device tier (which would
+        # spend the test budget JIT-compiling its verify kernel)
+        verifier.use_device = False
+        verifier.start()
+        conn, pool, reader, writer = _make_conn(verifier)
+        payloads = [_object_payload(1000 + i) for i in range(6)]
+        for p in payloads:
+            reader.feed_data(pack_packet("object", p))
+            await conn._read_packet()
+        for _ in range(500):
+            if len(pool.received) == len(payloads):
+                break
+            await asyncio.sleep(0.01)
+        await verifier.stop()
+        assert len(pool.received) == len(payloads)
+        for p in payloads:
+            assert conn.ctx.inventory[inventory_hash(p)].payload == p
+    asyncio.run(run())
+
+
+def test_non_object_commands_dispatch_materialized():
+    async def run():
+        conn, pool, reader, writer = _make_conn()
+        reader.feed_data(pack_packet("ping"))
+        await conn._read_packet()
+        assert bytes(writer.data).startswith(
+            pack_packet("pong")[:16])
+    asyncio.run(run())
+
+
+def test_bad_checksum_still_releases_buffer():
+    async def run():
+        conn, pool, reader, writer = _make_conn()
+        frame = bytearray(pack_packet("object", b"\x01" * 64))
+        frame[-1] ^= 0xFF            # corrupt the payload
+        reader.feed_data(bytes(frame))
+        parked0 = RECV_POOL.parked()
+        with pytest.raises(ConnectionClosed):
+            await conn._read_packet()
+        assert RECV_POOL.parked() >= parked0   # buffer came back
+    asyncio.run(run())

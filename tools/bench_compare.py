@@ -81,6 +81,20 @@ GUARDS: list[tuple[str, str, float]] = [
     # catches the only actionable signal — the engine becoming
     # catastrophically slower than the per-call path it replaces
     ("configs.batch_crypto.batch_speedup", "atleast", 0.5),
+    # zero-copy framing (ISSUE 11): bytes copied per payload byte is
+    # machine-independent — the pre-PR join-and-allocate path measured
+    # >= 2.0; the pooled path holds 1 + 1/dup_factor (~1.33).  The
+    # ceiling catches any copy creeping back into the packet path.
+    ("configs.zero_copy_framing.copies_per_payload_byte",
+     "atmost", 1.5),
+    ("configs.zero_copy_framing.frames_per_s", "higher", 0.60),
+    # slab store (ISSUE 11): sustained mixed ingest against the
+    # preloaded store, zero loss, and p99 flat through whole-slab TTL
+    # compaction (the full-mode 100k/s + <50ms bars are asserted
+    # inside bench.py; smoke guards the trend)
+    ("configs.slab_store.sustained_objects_per_s", "higher", 0.60),
+    ("configs.slab_store.zero_objects_lost", "equal", 0.0),
+    ("configs.slab_store.p99_flat_ratio", "atmost", 5.0),
     # sync: machine-independent bandwidth ratios + the loss invariant
     ("configs.sync_storm.announce_reduction_x", "higher", 0.30),
     ("configs.sync_storm.catchup_reduction_x", "higher", 0.30),
