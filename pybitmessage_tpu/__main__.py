@@ -120,7 +120,11 @@ async def run(args) -> int:
                 pow_window=settings.getfloat("powbatchwindow"),
                 sync_enabled=settings.getbool("syncenabled"),
                 wiretrace_enabled=settings.getbool("wiretrace"),
-                federation_enabled=settings.get("federation") != "off")
+                federation_enabled=settings.get("federation") != "off",
+                farm_listen=settings.get("powfarmlisten") or None,
+                farm_connect=settings.get("powfarmconnect") or None,
+                farm_tenant=settings.get("powfarmtenant"),
+                farm_secret=settings.get("powfarmsecret"))
     node.settings = settings
     node.dandelion.stem_probability = settings.getint("dandelion")
     node.processor.list_mode = settings.get("blackwhitelist")
@@ -196,6 +200,37 @@ async def run(args) -> int:
             settings.getint("breakerfailures")
         node.reconciler.breaker_cooldown = \
             settings.getfloat("breakercooldown")
+    # PoW solver farm knobs (docs/pow_farm.md)
+    if node.farm_server is not None:
+        from .powfarm import TenantConfig
+        srv = node.farm_server
+        srv.auth_required = settings.getbool("powfarmauth")
+        srv.batch_max = settings.getint("powfarmbatch")
+        srv.window = settings.getfloat("powfarmwindow")
+        srv.max_attempts = settings.getint("powmaxretries")
+        srv.scheduler.max_wait = settings.getfloat("powfarmmaxwait")
+        srv.scheduler.max_tenants = settings.getint("powfarmmaxtenants")
+        srv.scheduler.default_config = TenantConfig(
+            quota=settings.getint("powfarmquota"),
+            rate=settings.getfloat("powfarmrate"),
+            burst=settings.getfloat("powfarmburst"))
+        # the operator's tenant table (name:secret[:weight] list) —
+        # with powfarmauth=true this is the whole admission roster
+        from .core.config import parse_tenant_table
+        for name, secret, weight in parse_tenant_table(
+                settings.get("powfarmtenants")):
+            srv.register_tenant(name, TenantConfig(
+                weight=weight,
+                quota=settings.getint("powfarmquota"),
+                rate=settings.getfloat("powfarmrate"),
+                burst=settings.getfloat("powfarmburst"),
+                secret=secret.encode("utf-8")))
+    if node.farm_client is not None:
+        node.farm_client.deadline = settings.getfloat("powfarmdeadline")
+        node.farm_client.client.timeout = \
+            settings.getfloat("powfarmdeadline")
+        node.farm_client.bulk_threshold = \
+            settings.getint("powfarmbulkthreshold")
     # resilience knobs (docs/resilience.md)
     node.pool.dial_timeout = settings.getfloat("connecttimeout")
     node.pool.handshake_timeout = settings.getfloat("handshaketimeout")

@@ -869,6 +869,34 @@ class CommandHandler:
         from ..observability import LIFECYCLE
         return LIFECYCLE.snapshot()
 
+    def _farm_stats(self) -> dict:
+        """PoW solver-farm block for clientStatus (docs/pow_farm.md):
+        the farm daemon's scheduler/tenant state when this node serves
+        PoW-as-a-service, and the client tier's endpoint/breaker when
+        this node delegates its own PoW."""
+        from ..observability import REGISTRY
+        server = getattr(self.node, "farm_server", None)
+        client = getattr(self.node, "farm_client", None)
+        out: dict = {"serving": server is not None,
+                     "delegating": client is not None}
+        if server is not None:
+            out["server"] = server.status()
+            jobs = {}
+            fam = REGISTRY.get("farm_jobs_total")
+            if fam is not None:
+                for values, child in fam.children():
+                    jobs["/".join(values)] = int(child.value)
+            out["server"]["jobs"] = jobs
+        if client is not None:
+            out["client"] = client.snapshot()
+        return out
+
+    def cmd_farmStatus(self):
+        """Full PoW solver-farm status: scheduler snapshot (per-lane
+        depths, projected waits, per-tenant queued/solved/weights),
+        admission counters and the client tier's breaker state."""
+        return json.dumps(self._farm_stats(), indent=4)
+
     def cmd_clientStatus(self):
         pool = self.node.pool
         established = len(pool.established())
@@ -924,6 +952,9 @@ class CommandHandler:
             "powStats": self._pow_stats(),
             # failure-path health: breaker/stall/journal state (ISSUE 3)
             "resilience": self._resilience_stats(),
+            # PoW solver farm: daemon scheduler/tenants + client tier
+            # (docs/pow_farm.md)
+            "farm": self._farm_stats(),
             # composite per-subsystem health verdicts + loop lag
             # (ISSUE 6; observability/health.py)
             "health": self._health_stats(),

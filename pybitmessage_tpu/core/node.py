@@ -47,7 +47,11 @@ class Node:
                  pow_window: float | None = None,
                  sync_enabled: bool = True,
                  wiretrace_enabled: bool = True,
-                 federation_enabled: bool = True):
+                 federation_enabled: bool = True,
+                 farm_listen: str | None = None,
+                 farm_connect: str | None = None,
+                 farm_tenant: str = "default",
+                 farm_secret: str = ""):
         self.data_dir = Path(data_dir) if data_dir else None
         if self.data_dir:
             self.data_dir.mkdir(parents=True, exist_ok=True)
@@ -141,6 +145,30 @@ class Node:
                                           shutdown=self.shutdown,
                                           window=pow_window,
                                           journal=self.pow_journal)
+        #: PoW solver farm (docs/pow_farm.md): optionally delegate
+        #: this node's PoW to a shared farm (client rung on the
+        #: ladder) and/or serve PoW-as-a-service to other edges
+        self.farm_client = None
+        if farm_connect:
+            from ..powfarm import FarmSolverTier
+            fhost, _, fport = str(farm_connect).rpartition(":")
+            self.farm_client = FarmSolverTier(
+                fhost or "127.0.0.1", int(fport), tenant=farm_tenant,
+                secret=farm_secret.encode("utf-8")
+                if farm_secret else b"")
+            if hasattr(self.solver, "attach_farm"):
+                self.solver.attach_farm(self.farm_client)
+        self.farm_server = None
+        self.farm_journal = None
+        if farm_listen:
+            from ..powfarm import FarmJournal, FarmServer
+            fhost, _, fport = str(farm_listen).rpartition(":")
+            self.farm_journal = FarmJournal(
+                str(self.data_dir / "farmjournal.dat")
+                if self.data_dir else ":memory:")
+            self.farm_server = FarmServer(
+                self.solver, journal=self.farm_journal,
+                host=fhost or "127.0.0.1", port=int(fport))
 
         from .uisignal import UISignaler
         self.ui = UISignaler()
@@ -232,6 +260,8 @@ class Node:
         self.health.start()
         if self.federation_publisher is not None:
             self.federation_publisher.start()
+        if self.farm_server is not None:
+            await self.farm_server.start()
         logger.info("node started (port %s)",
                     self.pool.listen_port if self.listen else "-")
 
@@ -257,11 +287,17 @@ class Node:
         await self.sender.stop()
         await self.processor.stop()
         await self.cleaner.stop()
+        if self.farm_server is not None:
+            await self.farm_server.stop()
+        if self.farm_client is not None:
+            self.farm_client.close()
         if self.pow_service is not None:
             await self.pow_service.stop()
         await self.pow_verifier.stop()
         self.inventory.flush()
         self.knownnodes.save()
+        if self.farm_journal is not None:
+            self.farm_journal.close()
         self.pow_journal.close()
         self.db.close()
         logger.info("node stopped")

@@ -1,9 +1,17 @@
-"""Solver ladder: TPU -> C++ -> pure Python, with fallthrough.
+"""Solver ladder: farm -> TPU -> C++ -> pure Python, with fallthrough.
 
 Reference semantics (proofofwork.py:288-325): try the fastest backend;
 on failure log and fall through to the next; every tier is
 interruptible; the winning nonce is host-verified before being trusted
 (the TPU tier already re-checks internally, ops/pow_search.py).
+
+An attached :class:`~pybitmessage_tpu.powfarm.FarmSolverTier`
+(``attach_farm``) leads the ladder: jobs are delegated to a shared
+solver farm with deadline propagation and per-job trace contexts; ANY
+farm failure (dial, admission reject, expired deadline, bad nonce) is
+an ordinary tier failure — its breaker opens and the batch is
+requeued on the local ladder, so an unreachable farm degrades to
+exactly the pre-farm node (docs/pow_farm.md).
 
 Tier health is managed by per-tier circuit breakers
 (resilience/policy.py) instead of the old permanent latch: a failing
@@ -132,8 +140,11 @@ class PowDispatcher:
     def __init__(self, *, use_tpu: bool = True, use_native: bool = True,
                  tpu_kwargs: dict | None = None, num_threads: int = 0,
                  stall_timeout: float = DEFAULT_STALL_TIMEOUT,
-                 breakers: dict[str, CircuitBreaker] | None = None):
+                 breakers: dict[str, CircuitBreaker] | None = None,
+                 farm=None):
         self.tpu_kwargs = tpu_kwargs or {}
+        #: optional FarmSolverTier leading the ladder (attach_farm)
+        self.farm = farm
         self._tpu_enabled = use_tpu
         self._native = NativeSolver(num_threads) if use_native else None
         self.last_backend = ""
@@ -205,10 +216,47 @@ class PowDispatcher:
                     ndev, obj_axis="obj", obj_size=obj_size)
         return self._meshes[key]
 
+    def attach_farm(self, farm) -> None:
+        """Register a FarmSolverTier as the ladder's top rung."""
+        self.farm = farm
+
+    def _try_farm(self, items, should_stop, starts):
+        """Attempt the farm tier; ``None`` means fall through to the
+        local ladder (requeue-on-farm-failure — the accepted jobs are
+        re-solved locally, and the farm's journal dedupe makes any
+        overlap benign)."""
+        farm = self.farm
+        if farm is None or not farm.breaker.allow():
+            return None
+        try:
+            self.last_backend = "farm"
+            ATTEMPTS.labels(backend="farm").inc()
+            results = farm.solve_batch(items, should_stop=should_stop,
+                                       start_nonces=starts)
+            farm.breaker.record_success()
+            return results
+        except PowInterrupted:
+            farm.breaker.release_probe()
+            raise
+        except Exception as exc:
+            farm.breaker.record_failure()
+            ERRORS.labels(site="pow.tier.farm").inc()
+            logger.warning(
+                "farm tier failed (%r); requeueing %d job(s) on the "
+                "local ladder (breaker: %s)", exc, len(items),
+                farm.breaker.state)
+            next_tier = "tpu" if self._tpu_enabled else (
+                "native" if self._native is not None
+                and self._native.available else "python")
+            _note_fallback("farm", next_tier)
+            return None
+
     def backends(self) -> list[str]:
         """Currently-usable tiers: statically enabled AND not sitting
         behind an open (pre-cooldown) circuit breaker."""
         out = []
+        if self.farm is not None and self.farm.breaker.available():
+            out.append("farm")
         if self._tpu_enabled and self.breakers["tpu"].available():
             out.append("tpu")
         if self._native is not None and self._native.available and \
@@ -273,11 +321,13 @@ class PowDispatcher:
             return []
         starts = list(start_nonces) if start_nonces else [0] * len(items)
         t0 = time.monotonic()
-        results = None
         pb = self.breakers["tpu-pallas"]
         tb = self.breakers["tpu"]
         with trace("pow.solve_batch", objects=len(items)) as span:
-            if self._tpu_enabled and len(items) > 1:
+            # the farm rung leads the ladder; a farm failure falls
+            # through to the local tiers below with nothing lost
+            results = self._try_farm(items, should_stop, starts)
+            if results is None and self._tpu_enabled and len(items) > 1:
                 ndev = self._device_count()
                 if ndev > 1:
                     if self._on_accelerator() and pb.allow():
@@ -385,8 +435,12 @@ class PowDispatcher:
                     prog = None
                     if progress is not None:
                         prog = (lambda n, _i=i: progress(_i, n))
+                    # the batch already tried (or skipped) the farm —
+                    # per-item retries against a failing farm would
+                    # just re-pay its timeout N times
                     results.append(self._solve(ih, t, starts[i],
-                                               should_stop, progress=prog))
+                                               should_stop, progress=prog,
+                                               try_farm=False))
             span.attrs["backend"] = self.last_backend
         self._record_recovery()
         dt = max(time.monotonic() - t0, 1e-9)
@@ -423,7 +477,12 @@ class PowDispatcher:
         _note_fallback("tpu-pallas", to)
 
     def _solve(self, initial_hash, target, start_nonce, should_stop,
-               progress=None):
+               progress=None, try_farm=True):
+        if try_farm:
+            farmed = self._try_farm([(initial_hash, target)],
+                                    should_stop, [start_nonce])
+            if farmed is not None:
+                return farmed[0]
         tb = self.breakers["tpu"]
         pb = self.breakers["tpu-pallas"]
         if self._tpu_enabled and tb.allow():

@@ -1,0 +1,31 @@
+"""PoW solver farm: multi-tenant PoW-as-a-service (ROADMAP item 1).
+
+Many edge nodes delegate their proof-of-work to one shared solver
+farm over a small length-prefixed protocol — the piece that turns one
+fast pod into "millions of users", and the prerequisite for the
+light-client tier (clients that solve nothing).  Server side
+(:class:`FarmServer`): signed job submissions, crash-safe journaling
+(:class:`FarmJournal`), weighted deficit-round-robin fairness across
+tenants with two priority lanes and queue-depth-aware admission
+(:class:`FarmScheduler`), coalesced batches through the existing
+breaker-supervised dispatcher.  Client side (:class:`FarmSolverTier`):
+a new top rung of the solver ladder (farm -> tpu -> native -> pure)
+with deadline propagation, requeue-on-farm-failure back to local
+solving, and per-job wire trace contexts.
+
+docs/pow_farm.md documents the protocol, scheduler, admission model
+and tenant metrics.
+"""
+
+from .client import FarmClient, FarmError, FarmRejected, FarmSolverTier
+from .journal import FarmJournal
+from .protocol import LANE_BULK, LANE_INTERACTIVE, LANES
+from .scheduler import Admission, FarmJob, FarmScheduler, TenantConfig
+from .server import FarmServer
+
+__all__ = [
+    "FarmServer", "FarmScheduler", "FarmJournal", "FarmJob",
+    "TenantConfig", "Admission",
+    "FarmClient", "FarmSolverTier", "FarmError", "FarmRejected",
+    "LANES", "LANE_INTERACTIVE", "LANE_BULK",
+]

@@ -17,6 +17,9 @@ Site catalog (docs/resilience.md keeps the authoritative table):
 ``sync.sketch_decode`` sketch subtract/peel (reconciler gossip/catch-up)
 ``crypto.native``      a native batch-crypto drain (``crypto/batch.py``)
 ``storage.slab_io``    a slab drain/seal write (``storage/slabstore.py``)
+``farm.accept``        a farm job submission accept (``powfarm/server.py``)
+``farm.dispatch``      a farm batch launch through the solver ladder
+``farm.result``        a farm result frame send back to a client
 ==================  =====================================================
 
 Arming, one of:

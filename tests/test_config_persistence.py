@@ -45,6 +45,53 @@ def test_settings_validators(tmp_path):
     assert s.getint("dandelion") == 0
 
 
+def test_farm_knob_validators(tmp_path):
+    """ISSUE 12 satellite: the PoW solver-farm knobs are validated in
+    core/config.py (docs/pow_farm.md catalogs them)."""
+    s = Settings(tmp_path / "settings.dat")
+    for option, bad in [
+            ("powfarmlisten", "host:notaport"),
+            ("powfarmconnect", "farm:0"),        # 0 only valid to listen
+            ("powfarmconnect", "farm:99999"),
+            ("powfarmtenant", ""),
+            ("powfarmtenant", "x" * 65),
+            ("powfarmdeadline", "0"),
+            ("powfarmbulkthreshold", "0"),
+            ("powfarmbatch", "0"),
+            ("powfarmwindow", "11"),
+            ("powfarmmaxwait", "0"),
+            ("powfarmquota", "0"),
+            ("powfarmrate", "-1"),
+            ("powfarmburst", "0"),
+            ("powfarmmaxtenants", "0"),
+            ("powfarmauth", "maybe")]:
+        with pytest.raises(SettingsError):
+            s.set(option, bad)
+    s.set("powfarmlisten", "0.0.0.0:0")          # ephemeral port ok
+    s.set("powfarmconnect", "farm.internal:9444")
+    s.set("powfarmtenant", "edge-7")
+    s.set("powfarmrate", "12.5")
+    s.set("powfarmauth", True)
+    assert s.getfloat("powfarmrate") == 12.5
+    assert s.getbool("powfarmauth")
+
+
+def test_farm_tenant_table_parsing(tmp_path):
+    """The powfarmtenants knob is the config path into signed-
+    submissions mode: name:secret[:weight] comma list."""
+    from pybitmessage_tpu.core.config import parse_tenant_table
+    assert parse_tenant_table("") == []
+    assert parse_tenant_table("edge:s3cret") == [("edge", "s3cret", 1.0)]
+    assert parse_tenant_table("a:x:2.5, b:y ,c::0.5") == [
+        ("a", "x", 2.5), ("b", "y", 1.0), ("c", "", 0.5)]
+    s = Settings(tmp_path / "settings.dat")
+    s.set("powfarmtenants", "edge:s3cret:2,bulk:other")
+    for bad in ("justaname", "a:b:notaweight", "a:b:0", ":nosecret",
+                "%s:x" % ("n" * 65)):
+        with pytest.raises(SettingsError):
+            s.set("powfarmtenants", bad)
+
+
 def test_settings_save_creates_bak(tmp_path):
     p = tmp_path / "settings.dat"
     s = Settings(p)

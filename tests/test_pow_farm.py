@@ -1,0 +1,569 @@
+"""PoW solver farm (docs/pow_farm.md): protocol codecs, WDRR
+fairness, priority lanes, queue-aware admission, crash-safe journal
+adoption with restart dedupe, chaos at the farm.* sites, and the
+dispatcher's farm rung with requeue-on-farm-failure."""
+
+import asyncio
+import hashlib
+import time
+
+import pytest
+
+from pybitmessage_tpu.observability import REGISTRY
+from pybitmessage_tpu.powfarm import (FarmClient, FarmError, FarmJob,
+                                      FarmJournal, FarmRejected,
+                                      FarmScheduler, FarmServer,
+                                      FarmSolverTier, TenantConfig)
+from pybitmessage_tpu.powfarm.protocol import (LANE_BULK,
+                                               LANE_INTERACTIVE,
+                                               MAC_LEN, AcceptMsg,
+                                               ProtocolError,
+                                               RejectMsg, ResultMsg,
+                                               SubmitMsg, compute_mac,
+                                               mac_ok, pack_frame,
+                                               parse_header)
+from pybitmessage_tpu.pow.dispatcher import (PowDispatcher, host_trial,
+                                             python_solve)
+from pybitmessage_tpu.resilience import CHAOS
+
+#: trivial difficulty: ~4 expected trials per solve
+EASY_TARGET = 1 << 62
+
+
+def _ih(i: int) -> bytes:
+    return hashlib.sha512(b"farm job %d" % i).digest()
+
+
+class _StubSolver:
+    """Deterministic local ladder stand-in: python_solve plus an
+    optional per-batch delay to shape farm capacity."""
+
+    def __init__(self, delay: float = 0.0):
+        self.delay = delay
+        self.last_backend = "stub"
+        self.calls = 0
+
+    def solve_batch(self, items, *, should_stop=None, start_nonces=None,
+                    progress=None):
+        self.calls += 1
+        if self.delay:
+            time.sleep(self.delay)
+        starts = list(start_nonces) if start_nonces else [0] * len(items)
+        out = []
+        for i, (ih, target) in enumerate(items):
+            res = python_solve(ih, target, start_nonce=starts[i],
+                               should_stop=should_stop)
+            if progress is not None:
+                progress(i, res[0] + 1)
+            out.append(res)
+        return out
+
+
+# ---------------------------------------------------------------------------
+# protocol
+# ---------------------------------------------------------------------------
+
+def test_submit_roundtrip_with_mac():
+    secret = b"tenant secret"
+    msg = SubmitMsg(job_ref=7, tenant="edge-1", lane=LANE_BULK,
+                    initial_hash=_ih(1), target=EASY_TARGET,
+                    start_nonce=42, deadline_ms=1500,
+                    trace=b"\x01" * 32)
+    wire = msg.encode(secret)
+    back = SubmitMsg.decode(wire)
+    assert back.job_ref == 7
+    assert back.tenant == "edge-1"
+    assert back.lane == LANE_BULK
+    assert back.initial_hash == _ih(1)
+    assert back.target == EASY_TARGET
+    assert back.start_nonce == 42
+    assert back.deadline_ms == 1500
+    assert back.trace == b"\x01" * 32
+    assert len(back.mac) == MAC_LEN
+    assert mac_ok(secret, back._signed, back.mac)
+    assert not mac_ok(b"wrong", back._signed, back.mac)
+    # flipping any signed byte breaks the mac
+    tampered = SubmitMsg.decode(bytes([wire[0] ^ 1]) + wire[1:])
+    assert not mac_ok(secret, tampered._signed, tampered.mac)
+
+
+def test_other_codecs_roundtrip():
+    a = AcceptMsg.decode(AcceptMsg(1, 2, 3, 4).encode())
+    assert (a.job_ref, a.job_id, a.queue_depth, a.est_wait_ms) == \
+        (1, 2, 3, 4)
+    r = RejectMsg.decode(RejectMsg(9, "backlog", 250).encode())
+    assert (r.job_ref, r.reason, r.retry_after_ms) == (9, "backlog", 250)
+    res = ResultMsg.decode(ResultMsg(5, 0, 123, 456, 10, 20,
+                                     "ok").encode())
+    assert (res.job_ref, res.status, res.nonce, res.trials) == \
+        (5, 0, 123, 456)
+    assert res.detail == "ok"
+
+
+def test_frame_header_rejects_garbage():
+    with pytest.raises(ProtocolError):
+        parse_header(b"XX\x01\x01\x00\x00\x00\x00")
+    with pytest.raises(ProtocolError):
+        parse_header(b"\xfa\x12\x63\x01\x00\x00\x00\x00")  # bad version
+    with pytest.raises(ProtocolError):
+        SubmitMsg.decode(b"\x00" * 10)  # truncated
+    good = pack_frame(1, b"abc")
+    assert parse_header(good[:8]) == (1, 3)
+
+
+# ---------------------------------------------------------------------------
+# scheduler
+# ---------------------------------------------------------------------------
+
+def _job(tenant, lane=LANE_BULK, i=0):
+    return FarmJob(tenant=tenant, lane=lane, initial_hash=_ih(i),
+                   target=EASY_TARGET)
+
+
+def test_drr_equal_weights_fair():
+    s = FarmScheduler(capacity_hint=1000.0)
+    for t in range(4):
+        for i in range(50):
+            s.push(_job("t%d" % t, i=t * 100 + i))
+    drained = {"t%d" % t: 0 for t in range(4)}
+    # drain half the backlog in dispatcher-sized bites
+    for _ in range(10):
+        for job in s.take(10):
+            drained[job.tenant] += 1
+    counts = sorted(drained.values())
+    assert sum(counts) == 100
+    assert counts[-1] - counts[0] <= 1   # equal weights -> equal share
+
+
+def test_drr_weighted_shares():
+    s = FarmScheduler(capacity_hint=1000.0)
+    s.register("heavy", TenantConfig(weight=3.0))
+    s.register("light", TenantConfig(weight=1.0))
+    for i in range(120):
+        s.push(_job("heavy", i=i))
+        s.push(_job("light", i=1000 + i))
+    got = {"heavy": 0, "light": 0}
+    for job in s.take(80):
+        got[job.tenant] += 1
+    assert got["heavy"] + got["light"] == 80
+    ratio = got["heavy"] / max(got["light"], 1)
+    assert 2.0 <= ratio <= 4.0           # ~3x the drain share
+
+
+def test_fractional_weights_do_not_livelock():
+    s = FarmScheduler(capacity_hint=1000.0)
+    s.register("a", TenantConfig(weight=0.25))
+    s.register("b", TenantConfig(weight=0.25))
+    for i in range(10):
+        s.push(_job("a", i=i))
+        s.push(_job("b", i=100 + i))
+    assert len(s.take(20)) == 20
+
+
+def test_interactive_lane_drains_first():
+    s = FarmScheduler(capacity_hint=1000.0)
+    for i in range(10):
+        s.push(_job("t", LANE_BULK, i=i))
+    for i in range(3):
+        s.push(_job("t", LANE_INTERACTIVE, i=100 + i))
+    batch = s.take(5)
+    assert [j.lane for j in batch[:3]] == [LANE_INTERACTIVE] * 3
+    assert all(j.lane == LANE_BULK for j in batch[3:])
+
+
+def test_admission_quota_and_backlog_and_deadline():
+    s = FarmScheduler(capacity_hint=10.0, max_wait=1.0)
+    s.register("t", TenantConfig(quota=5))
+    for i in range(5):
+        assert s.admit("t", LANE_BULK).ok
+        s.push(_job("t", i=i))
+    # quota: the 6th queued job is refused with a backoff hint
+    verdict = s.admit("t", LANE_BULK)
+    assert not verdict.ok and verdict.reason == "quota"
+    assert verdict.retry_after > 0
+    # backlog: 5 queued jobs at 10 jobs/s is fine for another tenant,
+    # but 50 queued would project past max_wait
+    s.register("u", TenantConfig(quota=1000))
+    for i in range(50):
+        s.push(_job("u", i=100 + i))
+    verdict = s.admit("u", LANE_BULK)
+    assert not verdict.ok and verdict.reason == "backlog"
+    # deadline-aware: a job that cannot make its own deadline is
+    # refused immediately rather than accepted and expired later
+    verdict = s.admit("u", LANE_BULK, deadline_s=0.01)
+    assert not verdict.ok
+    # interactive lane only waits behind interactive jobs
+    assert s.admit("u", LANE_INTERACTIVE).ok
+
+
+def test_admission_token_bucket():
+    now = [0.0]
+    s = FarmScheduler(capacity_hint=1e6, clock=lambda: now[0])
+    s.register("t", TenantConfig(rate=10.0, burst=2.0))
+    assert s.admit("t", LANE_BULK).ok
+    assert s.admit("t", LANE_BULK).ok
+    verdict = s.admit("t", LANE_BULK)
+    assert not verdict.ok and verdict.reason == "rate"
+    assert verdict.retry_after == pytest.approx(0.1, abs=0.05)
+    now[0] += 0.2                        # two tokens refill
+    assert s.admit("t", LANE_BULK).ok
+
+
+def test_auto_registration_cap():
+    s = FarmScheduler(max_tenants=2)
+    assert s.admit("a", LANE_BULK).ok
+    assert s.admit("b", LANE_BULK).ok
+    verdict = s.admit("c", LANE_BULK)
+    assert not verdict.ok and verdict.reason == "tenant_limit"
+
+
+# ---------------------------------------------------------------------------
+# farm journal
+# ---------------------------------------------------------------------------
+
+def test_farm_journal_meta_roundtrip_and_dedupe(tmp_path):
+    path = str(tmp_path / "farmjournal.dat")
+    j = FarmJournal(path)
+    job_id, start = j.add(_ih(1), EASY_TARGET,
+                          meta={"tenant": "edge", "lane": "bulk"})
+    assert start == 0
+    # duplicate key adopts the existing row
+    again, _ = j.add(_ih(1), EASY_TARGET, meta={"tenant": "other"})
+    assert again == job_id
+    assert j.pending_count() == 1
+    j.checkpoint(job_id, 5000)
+    j.mark_inflight(job_id)
+    j.close()
+    # restart: inflight -> queued adoption keeps meta + checkpoint
+    j2 = FarmJournal(path)
+    pending = j2.pending_meta()
+    assert len(pending) == 1
+    pj, meta = pending[0]
+    assert pj.status == "queued"
+    assert pj.start_nonce == 5000
+    assert meta == {"tenant": "edge", "lane": "bulk"}
+    j2.close()
+
+
+def test_farm_journal_readable_by_base_rows(tmp_path):
+    """A journal written by the base PowJournal (no meta column) is
+    adopted cleanly — meta degrades to {}."""
+    from pybitmessage_tpu.resilience.journal import PowJournal
+    path = str(tmp_path / "mixed.dat")
+    base = PowJournal(path)
+    base.add(_ih(2), EASY_TARGET)
+    base.close()
+    j = FarmJournal(path)
+    pending = j.pending_meta()
+    assert len(pending) == 1
+    assert pending[0][1] == {}
+    j.close()
+
+
+# ---------------------------------------------------------------------------
+# server + client end-to-end
+# ---------------------------------------------------------------------------
+
+async def _run_farm(solver=None, **kw):
+    server = FarmServer(solver or _StubSolver(), window=0.0, **kw)
+    await server.start()
+    return server
+
+
+def _client_solve(client, items, **kw):
+    """Run the blocking client off the loop."""
+    loop = asyncio.get_running_loop()
+    return loop.run_in_executor(
+        None, lambda: client.solve_batch(items, **kw))
+
+
+@pytest.mark.asyncio
+async def test_farm_solves_and_verifies():
+    server = await _run_farm()
+    client = FarmClient("127.0.0.1", server.listen_port, tenant="e1")
+    try:
+        items = [(_ih(i), EASY_TARGET) for i in range(4)]
+        results = await _client_solve(client, items)
+        assert len(results) == 4
+        for (ih, target), (nonce, trials) in zip(items, results):
+            assert host_trial(nonce, ih) <= target
+            assert trials >= 1
+        assert server.status()["scheduler"]["tenants"]["e1"]["solved"] \
+            == 4
+    finally:
+        client.close()
+        await server.stop()
+
+
+@pytest.mark.asyncio
+async def test_farm_ping():
+    server = await _run_farm()
+    client = FarmClient("127.0.0.1", server.listen_port)
+    try:
+        ok = await asyncio.get_running_loop().run_in_executor(
+            None, client.ping)
+        assert ok
+    finally:
+        client.close()
+        await server.stop()
+
+
+@pytest.mark.asyncio
+async def test_signed_submissions_auth():
+    server = await _run_farm(auth_required=True)
+    server.register_tenant("paid", TenantConfig(secret=b"s3cret"))
+    good = FarmClient("127.0.0.1", server.listen_port, tenant="paid",
+                      secret=b"s3cret")
+    bad_secret = FarmClient("127.0.0.1", server.listen_port,
+                            tenant="paid", secret=b"wrong")
+    unknown = FarmClient("127.0.0.1", server.listen_port,
+                         tenant="stranger")
+    try:
+        results = await _client_solve(good, [(_ih(1), EASY_TARGET)])
+        assert host_trial(results[0][0], _ih(1)) <= EASY_TARGET
+        with pytest.raises(FarmRejected) as exc_info:
+            await _client_solve(bad_secret, [(_ih(2), EASY_TARGET)])
+        assert exc_info.value.reason == "auth"
+        with pytest.raises(FarmRejected) as exc_info:
+            await _client_solve(unknown, [(_ih(3), EASY_TARGET)])
+        assert exc_info.value.reason == "auth"
+    finally:
+        good.close()
+        bad_secret.close()
+        unknown.close()
+        await server.stop()
+
+
+@pytest.mark.asyncio
+async def test_admission_reject_carries_retry_after():
+    scheduler = FarmScheduler(capacity_hint=0.5, max_wait=0.2)
+    server = await _run_farm(_StubSolver(delay=0.2),
+                             scheduler=scheduler)
+    client = FarmClient("127.0.0.1", server.listen_port, tenant="t")
+    try:
+        # a flood far past 0.5 jobs/s * 0.2 s projected-wait budget
+        with pytest.raises(FarmRejected) as exc_info:
+            await _client_solve(
+                client, [(_ih(i), EASY_TARGET) for i in range(16)],
+                lane=LANE_BULK)
+        assert exc_info.value.reason == "backlog"
+        assert exc_info.value.retry_after > 0
+    finally:
+        client.close()
+        await server.stop()
+
+
+@pytest.mark.asyncio
+async def test_farm_accept_chaos_is_a_retryable_reject():
+    CHAOS.arm("farm.accept", probability=1.0, count=1)
+    try:
+        server = await _run_farm()
+        client = FarmClient("127.0.0.1", server.listen_port)
+        try:
+            with pytest.raises(FarmRejected) as exc_info:
+                await _client_solve(client, [(_ih(1), EASY_TARGET)])
+            assert exc_info.value.reason == "unavailable"
+            # second attempt (chaos exhausted) succeeds — no loss
+            results = await _client_solve(client,
+                                          [(_ih(1), EASY_TARGET)])
+            assert host_trial(results[0][0], _ih(1)) <= EASY_TARGET
+        finally:
+            client.close()
+            await server.stop()
+    finally:
+        CHAOS.disarm()
+
+
+@pytest.mark.asyncio
+async def test_farm_dispatch_chaos_requeues_without_loss():
+    CHAOS.arm("farm.dispatch", probability=1.0, count=2)
+    try:
+        server = await _run_farm(max_attempts=5)
+        server.retry.base_delay = 0.01
+        client = FarmClient("127.0.0.1", server.listen_port)
+        try:
+            items = [(_ih(i), EASY_TARGET) for i in range(3)]
+            results = await _client_solve(client, items)
+            for (ih, target), (nonce, _) in zip(items, results):
+                assert host_trial(nonce, ih) <= target
+            assert REGISTRY.sample("farm_requeue_total",
+                                   {"reason": "failure"}) >= 1
+        finally:
+            client.close()
+            await server.stop()
+    finally:
+        CHAOS.disarm()
+
+
+@pytest.mark.asyncio
+async def test_farm_result_chaos_recovers_from_recent_cache():
+    server = await _run_farm()
+    client = FarmClient("127.0.0.1", server.listen_port)
+    CHAOS.arm("farm.result", probability=1.0, count=1)
+    try:
+        # first attempt: the result frame send is chaos-dropped; the
+        # client times out and falls back — but the nonce is cached
+        with pytest.raises(FarmError):
+            await _client_solve(client, [(_ih(9), EASY_TARGET)],
+                                deadline_s=0.6)
+        solver_calls = server.solver.calls
+        # resubmission is answered from the recent cache without
+        # burning solver time
+        results = await _client_solve(client, [(_ih(9), EASY_TARGET)])
+        assert host_trial(results[0][0], _ih(9)) <= EASY_TARGET
+        assert server.solver.calls == solver_calls
+    finally:
+        CHAOS.disarm()
+        client.close()
+        await server.stop()
+
+
+@pytest.mark.asyncio
+async def test_restart_adoption_dedupes_resubmission(tmp_path):
+    """THE satellite fix: a farm restart adopts journaled jobs into
+    the scheduler; a client re-submitting the same (initial_hash,
+    target) attaches to the recovered job instead of double-enqueuing
+    it, and the collision is counted."""
+    path = str(tmp_path / "farm.dat")
+    journal = FarmJournal(path)
+    # a job journaled by a previous farm process, killed mid-flight
+    jid, _ = journal.add(_ih(5), EASY_TARGET,
+                         meta={"tenant": "edge", "lane": "interactive"})
+    journal.mark_inflight(jid)
+    journal.close()
+
+    collisions0 = REGISTRY.sample("farm_adopt_collisions_total")
+    journal2 = FarmJournal(path)     # inflight -> queued adoption
+    slow = _StubSolver(delay=0.5)    # keep the job queued long enough
+    server = FarmServer(slow, journal=journal2, window=0.0)
+    await server.start()
+    client = FarmClient("127.0.0.1", server.listen_port, tenant="edge")
+    try:
+        assert server.status()["pendingJobs"] == 1
+        fut = _client_solve(client, [(_ih(5), EASY_TARGET)])
+        results = await fut
+        assert host_trial(results[0][0], _ih(5)) <= EASY_TARGET
+        assert REGISTRY.sample("farm_adopt_collisions_total") == \
+            collisions0 + 1
+        # the adopted job was NOT double-enqueued: exactly one solve
+        assert slow.calls == 1
+        assert journal2.pending_count() == 0
+    finally:
+        client.close()
+        await server.stop()
+        journal2.close()
+
+
+@pytest.mark.asyncio
+async def test_dispatcher_farm_rung_and_local_fallback():
+    """farm -> local ladder: the dispatcher delegates to the farm
+    while it is up, and a dead farm degrades to local solving with
+    the tier breaker open."""
+    server = await _run_farm()
+    tier = FarmSolverTier("127.0.0.1", server.listen_port,
+                          tenant="edge", deadline=10.0)
+    tier.breaker.reset()
+    dispatcher = PowDispatcher(use_tpu=False, use_native=False,
+                               farm=tier)
+    loop = asyncio.get_running_loop()
+    try:
+        assert "farm" in dispatcher.backends()
+        # the dispatcher is executor-side in production (PowService);
+        # calling it on the loop would deadlock against the server
+        nonce, trials = await loop.run_in_executor(
+            None, dispatcher.solve, _ih(1), EASY_TARGET)
+        assert dispatcher.last_backend == "farm"
+        assert host_trial(nonce, _ih(1)) <= EASY_TARGET
+        results = await loop.run_in_executor(
+            None, dispatcher.solve_batch,
+            [(_ih(2), EASY_TARGET), (_ih(3), EASY_TARGET)])
+        assert dispatcher.last_backend == "farm"
+        assert len(results) == 2
+    finally:
+        await server.stop()
+    # farm is gone: requeue-on-farm-failure lands on the local ladder
+    fallbacks0 = REGISTRY.sample("pow_fallback_total",
+                                 {"from": "farm", "to": "python"})
+    nonce, _ = dispatcher.solve(_ih(4), EASY_TARGET)
+    assert host_trial(nonce, _ih(4)) <= EASY_TARGET
+    assert dispatcher.last_backend == "python"
+    assert REGISTRY.sample("pow_fallback_total",
+                           {"from": "farm", "to": "python"}) == \
+        fallbacks0 + 1
+    # breaker (threshold 2) opens after a second failure and the farm
+    # leaves backends() until its cooldown
+    dispatcher.solve(_ih(5), EASY_TARGET)
+    assert "farm" not in dispatcher.backends()
+    tier.close()
+
+
+@pytest.mark.asyncio
+async def test_lane_heuristic_and_deadline_propagation():
+    server = await _run_farm()
+    tier = FarmSolverTier("127.0.0.1", server.listen_port,
+                          bulk_threshold=2, deadline=30.0)
+    tier.breaker.reset()
+    try:
+        assert tier.lane_for(1) == LANE_INTERACTIVE
+        assert tier.lane_for(2) == LANE_INTERACTIVE
+        assert tier.lane_for(3) == LANE_BULK
+        # a context-propagated Deadline tightens the wire budget
+        from pybitmessage_tpu.resilience import Deadline
+        with Deadline(5.0):
+            assert tier._budget() <= 5.0
+        assert tier._budget() == 30.0
+        results = await asyncio.get_running_loop().run_in_executor(
+            None, tier.solve_batch, [(_ih(1), EASY_TARGET)])
+        assert host_trial(results[0][0], _ih(1)) <= EASY_TARGET
+    finally:
+        tier.close()
+        await server.stop()
+
+
+@pytest.mark.asyncio
+async def test_farm_rejects_lying_solver():
+    """A farm returning a bad nonce is a failed tier, not a corrupted
+    send: the client host-verifies every result."""
+
+    class _Liar:
+        last_backend = "liar"
+
+        def solve_batch(self, items, **kw):
+            return [(0, 1) for _ in items]   # nonce 0 will not verify
+
+    server = await _run_farm(_Liar())
+    tier = FarmSolverTier("127.0.0.1", server.listen_port)
+    tier.breaker.reset()
+    try:
+        with pytest.raises(FarmError):
+            await asyncio.get_running_loop().run_in_executor(
+                None, tier.solve_batch, [(_ih(1), 1)])  # bad nonce
+    finally:
+        tier.close()
+        await server.stop()
+
+
+@pytest.mark.asyncio
+async def test_node_farm_wiring(tmp_path):
+    """Node-level knobs: one node serves the farm, another delegates
+    its PoW to it through the ladder's farm rung."""
+    from pybitmessage_tpu.core.node import Node
+    farm_node = Node(listen=False, solver=_StubSolver(),
+                     udp_enabled=False, federation_enabled=False,
+                     farm_listen="127.0.0.1:0")
+    await farm_node.start()
+    try:
+        port = farm_node.farm_server.listen_port
+        edge = Node(listen=False, udp_enabled=False,
+                    federation_enabled=False,
+                    farm_connect="127.0.0.1:%d" % port)
+        assert edge.farm_client is not None
+        assert "farm" in edge.solver.backends()
+        edge.farm_client.breaker.reset()
+        nonce, _ = await asyncio.get_running_loop().run_in_executor(
+            None, edge.solver.solve, _ih(1), EASY_TARGET)
+        assert edge.solver.last_backend == "farm"
+        assert host_trial(nonce, _ih(1)) <= EASY_TARGET
+        await edge.stop()
+    finally:
+        await farm_node.stop()

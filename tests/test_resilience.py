@@ -275,6 +275,74 @@ def test_journal_purges_stale_rows(tmp_path):
     j2.close()
 
 
+def test_journal_two_owner_concurrent_requeue_and_checkpoint():
+    """ISSUE 12 satellite: the farm and a local fallback can briefly
+    BOTH hold the same journaled job (requeue-on-farm-failure overlaps
+    the farm's own retry).  Hammering add/checkpoint/requeue from two
+    threads must keep exactly one row with a monotonic checkpoint."""
+    import threading
+
+    j = PowJournal()
+    target = 2 ** 44
+    barrier = threading.Barrier(2)
+    errors = []
+
+    def owner(base: int) -> None:
+        try:
+            barrier.wait()
+            for i in range(200):
+                jid, _ = j.add(IH, target)       # adopt, never dup
+                j.mark_inflight(jid)
+                j.checkpoint(jid, base + i * 4096)
+                j.requeue(jid)
+        except Exception as exc:  # pragma: no cover - fail the test
+            errors.append(exc)
+
+    threads = [threading.Thread(target=owner, args=(b,))
+               for b in (1 << 20, 1 << 21)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors
+    assert j.pending_count() == 1, "two owners must share ONE row"
+    jid, start = j.add(IH, target)
+    # monotonic: the highest offset either owner reported wins
+    assert start == (1 << 21) + 199 * 4096
+    # either owner completing is final: the other's late requeue must
+    # not resurrect the job...
+    j.complete(jid)
+    j.requeue(jid)
+    j.checkpoint(jid, 1 << 30)
+    assert j.pending_count() == 0
+    # ...and a genuine re-submission starts honestly from zero
+    jid2, start2 = j.add(IH, target)
+    assert jid2 != jid and start2 == 0
+    j.close()
+
+
+def test_journal_age_purge_spares_fresh_checkpoint_resume(tmp_path):
+    """Age purge at open removes only abandoned rows; a fresh row
+    that two owners checkpointed keeps resuming from its offset."""
+    path = str(tmp_path / "powjournal.dat")
+    j = PowJournal(path)
+    stale_id, _ = j.add(hashlib.sha512(b"stale").digest(), 7)
+    fresh_id, _ = j.add(IH, 2 ** 42)
+    j.checkpoint(fresh_id, 123 * 4096)
+    j.mark_inflight(fresh_id)
+    # age ONLY the first row beyond the purge horizon
+    j._conn.execute("UPDATE powjobs SET enqueued_at = enqueued_at - ?"
+                    " WHERE id = ?", (30 * 24 * 3600, stale_id))
+    j.close()                    # crash point with both rows present
+    j2 = PowJournal(path)
+    jobs = j2.pending()
+    assert [job.initial_hash for job in jobs] == [IH]
+    assert jobs[0].status == "queued"    # inflight -> queued adoption
+    _, resumed = j2.add(IH, 2 ** 42)
+    assert resumed == 123 * 4096
+    j2.close()
+
+
 # ---------------------------------------------------------------------------
 # chaos registry
 # ---------------------------------------------------------------------------

@@ -95,6 +95,18 @@ GUARDS: list[tuple[str, str, float]] = [
     ("configs.slab_store.sustained_objects_per_s", "higher", 0.60),
     ("configs.slab_store.zero_objects_lost", "equal", 0.0),
     ("configs.slab_store.p99_flat_ratio", "atmost", 5.0),
+    # ingest end-to-end with the slab backend in the loop (ISSUE 12
+    # satellite: socket -> batch crypto -> slab store)
+    ("configs.ingest_storm.end_to_end_slab.objects_per_s",
+     "higher", 0.60),
+    # PoW solver farm (ISSUE 12): zero accepted job may ever be lost,
+    # equal-weight tenants must drain within a bounded goodput spread
+    # (full mode asserts <=1.5; the smoke band absorbs CI noise), and
+    # the interactive lane must stay at least severalfold ahead of
+    # bulk under overload (full mode asserts >=5x)
+    ("configs.pow_farm.zero_job_loss", "equal", 0.0),
+    ("configs.pow_farm.fairness.max_min_ratio", "atmost", 1.5),
+    ("configs.pow_farm.lane_p99_split", "atleast", 3.0),
     # sync: machine-independent bandwidth ratios + the loss invariant
     ("configs.sync_storm.announce_reduction_x", "higher", 0.30),
     ("configs.sync_storm.catchup_reduction_x", "higher", 0.30),
