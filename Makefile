@@ -33,7 +33,7 @@ bench: native
 chaos: native
 	JAX_PLATFORMS=cpu BMTPU_CHAOS_SEED=1234 python -m pytest \
 		tests/test_resilience.py tests/test_resilience_chaos.py \
-		tests/test_pow_farm.py \
+		tests/test_pow_farm.py tests/test_crypto_tpu.py \
 		-q -m 'not slow'
 
 # tiny CPU-only bench for CI: reduced slabs, reference test-mode

@@ -721,7 +721,119 @@ def _bench_batch_crypto(verifies: int = 128, decrypt_objects: int = 16,
         "batched_s": round(batched, 3),
         "percall_s": round(percall, 3),
         "batch_speedup": ratios[len(ratios) // 2],
+        # ISSUE 13 satellite: the same drain shapes through the tpu
+        # rung vs the native rung, host-verified sample
+        "tpu_vs_native": _bench_tpu_vs_native(drain=max(verifies, 64)),
     }
+
+
+def _bench_tpu_vs_native(drain: int = 256, sample: int = 8) -> dict:
+    """tpu-rung vs native-rung drain throughput (ISSUE 13): the SAME
+    prepared verify/ECDH drains through ``TpuSecp`` and ``NativeSecp``
+    back to back, with a host-verified sample of the results.
+
+    On CPU CI the tpu rung runs its XLA path — the honest figure there
+    is PARITY and zero loss (perfguard floors ``parity_ok``/
+    ``zero_loss``), not speed; ``target_speedup_v5e`` records the
+    acceptance bar for the next hardware run in the JSON schema.
+    """
+    import hashlib
+    import random
+
+    from pybitmessage_tpu.crypto import fallback
+    from pybitmessage_tpu.crypto import tpu as crypto_tpu
+    from pybitmessage_tpu.crypto.native import get_native
+
+    _N = fallback.N
+    # force the rung on for the measurement (auto = off on CPU), and
+    # restore afterwards so later sections see the configured mode
+    prev_mode = crypto_tpu.mode()
+    crypto_tpu.configure("on")
+    crypto_tpu.reset_tpu()
+    tpu = crypto_tpu.get_tpu()
+    try:
+        if not tpu.available:
+            return {"skipped": "jax unavailable", "parity_ok": 1.0,
+                    "zero_loss": 1.0}
+        rng = random.Random(1337)
+        u1s, u2s, pubs, rs, oracle = [], [], [], [], []
+        for i in range(drain):
+            priv = rng.randrange(1, _N)
+            data = b"tpu bench %d" % i
+            e = fallback.digest_to_scalar(hashlib.sha256(data).digest())
+            sig = fallback.ecdsa_sign_digest(
+                hashlib.sha256(data).digest(), priv.to_bytes(32, "big"))
+            r, s = fallback.der_decode_sig(sig)
+            if i % 7 == 6:          # corrupt ~14%: must fail on BOTH
+                e = (e + 1) % _N
+            w = pow(s, -1, _N)
+            u1s.append(((e * w) % _N).to_bytes(32, "big"))
+            u2s.append(((r * w) % _N).to_bytes(32, "big"))
+            pub = fallback.priv_to_pub(priv.to_bytes(32, "big"))
+            pubs.append(pub[1:])
+            rs.append(r.to_bytes(32, "big"))
+            px, py = fallback.decode_point(pub)
+            oracle.append((e, r, s, (px, py)))
+        points = b"".join(pubs)
+        scalars = b"".join(
+            rng.randrange(1, _N).to_bytes(32, "big")
+            for _ in range(drain))
+        args = (drain, b"".join(u1s), b"".join(u2s), points,
+                b"".join(rs))
+
+        def run_rung(backend):
+            backend.verify_prepared(*args)          # warm/compile
+            backend.ecdh_batch(drain, points, scalars)
+            t0 = time.perf_counter()
+            oks = backend.verify_prepared(*args)
+            tv = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            xs = backend.ecdh_batch(drain, points, scalars)
+            te = time.perf_counter() - t0
+            return oks, xs, tv, te
+
+        tpu_ok, tpu_x, tpu_tv, tpu_te = run_rung(tpu)
+        native = get_native()
+        out: dict = {
+            "drain_size": drain,
+            "tpu_kernel": tpu.snapshot()["kernel"],
+            "tpu_platform": tpu.platform,
+            "tpu_verify_ops_s": round(drain / max(tpu_tv, 1e-9), 1),
+            "tpu_ecdh_ops_s": round(drain / max(tpu_te, 1e-9), 1),
+            # acceptance bar for the next v5e run, recorded in-schema
+            "target_speedup_v5e": 10.0,
+        }
+        # host-verify a sample of the tpu results against the oracle
+        idx = rng.sample(range(drain), min(sample, drain))
+        parity = all(
+            bool(tpu_ok[i]) == fallback.ecdsa_verify_scalars(
+                *oracle[i][:3], oracle[i][3]) for i in idx)
+        parity &= all(
+            tpu_x[i] == fallback.ecdh_x(
+                scalars[32 * i:32 * i + 32],
+                b"\x04" + points[64 * i:64 * i + 64]) for i in idx)
+        if native.available:
+            nat_ok, nat_x, nat_tv, nat_te = run_rung(native)
+            parity &= (tpu_ok == nat_ok and tpu_x == nat_x)
+            out.update({
+                "native_verify_ops_s": round(
+                    drain / max(nat_tv, 1e-9), 1),
+                "native_ecdh_ops_s": round(drain / max(nat_te, 1e-9),
+                                           1),
+                "verify_speedup": round(nat_tv / max(tpu_tv, 1e-9), 3),
+                "ecdh_speedup": round(nat_te / max(tpu_te, 1e-9), 3),
+            })
+        # no assert here: a divergence must land in the JSON as
+        # parity_ok=0.0 so the perfguard `atleast 1.0` floor is the
+        # thing that fails (an assert would kill the run before the
+        # JSON exists and the band could never fire)
+        out["parity_ok"] = 1.0 if parity else 0.0
+        out["zero_loss"] = 1.0 if (
+            len(tpu_ok) == drain and len(tpu_x) == drain) else 0.0
+        return out
+    finally:
+        crypto_tpu.configure(prev_mode)
+        crypto_tpu.reset_tpu()
 
 
 def _bench_ingest_storm(identities: int = 8, objects: int = 400,
@@ -847,12 +959,16 @@ def _bench_ingest_storm(identities: int = 8, objects: int = 400,
         crypto_work = (delta["batch_decrypt"] + delta["batch_verify"]
                        if pipelined else
                        delta["stage_decrypt"] + delta["stage_sig_verify"])
+        engine = proc.crypto.batch
         return {
             "wall_s": round(dt, 3),
             "objects_per_s": round(len(payloads) / dt, 1),
             "delivered": delivered,
             "crypto_work_s": round(crypto_work, 4),
             "max_loop_lag_ms": round(prober.max_lag * 1e3, 2),
+            # which crypto rung actually served the drains (ISSUE 13):
+            # tpu / native / pure, None when no drain ran
+            "crypto_rung": engine.last_path if engine else "per-call",
         }
 
     async def run_e2e_slab() -> dict:
@@ -986,6 +1102,9 @@ def _bench_ingest_storm(identities: int = 8, objects: int = 400,
         "crypto_backend": "native" if get_native().available else (
             "openssl" if have_openssl() else "pure"),
         "inline_backend": "openssl" if have_openssl() else "pure",
+        # the ladder rung (tpu/native/pure) the pipelined run's drains
+        # actually landed on (ISSUE 13; docs/crypto.md)
+        "crypto_rung": pipe.get("crypto_rung"),
         "crypto_stage_speedup": round(
             inline["crypto_work_s"] / max(pipe["crypto_work_s"], 1e-9),
             2),

@@ -175,11 +175,18 @@ async def run(args) -> int:
     # with the batch engine off
     from .crypto.native import set_native_enabled
     set_native_enabled(settings.getbool("cryptonative"))
+    # accelerator rung (docs/crypto.md): cryptotpu configures the
+    # process-wide probe mode (auto = TPU backend only); the engine
+    # flag and the launch-worthiness floor ride alongside
+    from .crypto import tpu as crypto_tpu
+    crypto_tpu.configure(settings.get("cryptotpu"))
     if not settings.getbool("cryptobatch"):
         node.processor.crypto.batch = None
     elif node.processor.crypto.batch is not None:
         engine = node.processor.crypto.batch
         engine.use_native = settings.getbool("cryptonative")
+        engine.use_tpu = crypto_tpu.mode() != "off"
+        engine.tpu_batch_min = settings.getint("cryptotpubatchmin")
         engine.window = settings.getfloat("cryptobatchwindow")
         engine.num_threads = settings.getint("cryptonativethreads")
     queue = node.ctx.object_queue

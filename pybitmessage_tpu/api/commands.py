@@ -869,6 +869,41 @@ class CommandHandler:
         from ..observability import LIFECYCLE
         return LIFECYCLE.snapshot()
 
+    def _crypto_stats(self) -> dict:
+        """Receive-side crypto ladder block (docs/crypto.md): the rung
+        the last drain ran on, per-rung item counts, fallback
+        counters, breaker states and the tpu rung's probe snapshot —
+        all read from existing state (no probe or library load is
+        forced by a status call)."""
+        from ..crypto import tpu as crypto_tpu
+        from ..observability import REGISTRY
+        engine = getattr(getattr(self.node.processor, "crypto", None),
+                         "batch", None)
+        out: dict = {
+            "batchEngine": engine is not None and engine.running,
+            "tpu": crypto_tpu.get_tpu().snapshot(),
+        }
+        if engine is not None:
+            out.update({
+                "activeRung": engine.last_path,
+                "batchMin": engine.tpu_batch_min,
+                "items": {"tpu": engine.tpu_items,
+                          "native": engine.native_items,
+                          "pure": engine.pure_items},
+                "breakers": {
+                    "tpu": engine.tpu_breaker.snapshot()["state"],
+                    "native": engine.breaker.snapshot()["state"],
+                },
+            })
+        out["fallbacks"] = {
+            "tpu": int(REGISTRY.sample("crypto_tpu_fallback_total")),
+            "native": int(REGISTRY.sample(
+                "crypto_native_fallback_total")),
+            "digest": int(REGISTRY.sample(
+                "crypto_digest_fallback_total")),
+        }
+        return out
+
     def _farm_stats(self) -> dict:
         """PoW solver-farm block for clientStatus (docs/pow_farm.md):
         the farm daemon's scheduler/tenant state when this node serves
@@ -952,6 +987,9 @@ class CommandHandler:
             "powStats": self._pow_stats(),
             # failure-path health: breaker/stall/journal state (ISSUE 3)
             "resilience": self._resilience_stats(),
+            # receive-side crypto ladder: active rung, per-rung items,
+            # fallbacks (ISSUE 13; docs/crypto.md)
+            "crypto": self._crypto_stats(),
             # PoW solver farm: daemon scheduler/tenants + client tier
             # (docs/pow_farm.md)
             "farm": self._farm_stats(),

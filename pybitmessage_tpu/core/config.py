@@ -107,6 +107,16 @@ DEFAULTS: dict[str, str] = {
                                      # native batch call (raise on
                                      # wide hosts; 0 = all hardware
                                      # threads)
+    # -- accelerator-resident batch crypto (docs/crypto.md) --
+    "cryptotpu": "auto",             # tpu rung of the crypto ladder:
+                                     # auto = only on a real TPU
+                                     # backend, on = force (XLA path
+                                     # on CPU — the CI parity mode),
+                                     # off = never probe
+    "cryptotpubatchmin": "64",       # min drain size (checks +
+                                     # trial-decrypt objects) worth a
+                                     # device launch; smaller drains
+                                     # start at the native rung
     # -- set-reconciliation sync (docs/sync.md) --
     "syncenabled": "true",           # sketch-based inventory sync
                                      # (negotiated; old peers keep
@@ -280,6 +290,10 @@ VALIDATORS: dict[str, Callable[[str], bool]] = {
     "cryptonative": _validate_bool,
     "cryptobatchwindow": _validate_float_range(0.0, 10.0),
     "cryptonativethreads": _validate_int_range(0, 256),
+    "cryptotpu": lambda v: v.lower() in ("auto", "on", "off", "true",
+                                         "false", "0", "1", "yes",
+                                         "no"),
+    "cryptotpubatchmin": _validate_int_range(1, 1 << 20),
     "syncenabled": _validate_bool,
     "syncinterval": _validate_float_range(0.5, 3600.0),
     "syncfanout": _validate_int_range(-1, 1000),

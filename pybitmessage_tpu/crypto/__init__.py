@@ -1,12 +1,15 @@
 """secp256k1 cryptography: ECIES, ECDSA, key management.
 
 A clean-room Python-3 implementation of the wire formats the
-Bitmessage network requires, over a three-tier backend ladder
-(mirroring the PoW solver ladder): the OpenSSL-backed ``cryptography``
-package where installed, the native batch engine
-(``native/secp256k1/`` via ``crypto/native.py``), and the pure-Python
-tier (``crypto/fallback.py``) everywhere.  Receive-side hot paths
-additionally coalesce into batch drains (``crypto/batch.py``,
+Bitmessage network requires, over a backend ladder (mirroring the PoW
+solver ladder): the OpenSSL-backed ``cryptography`` package where
+installed, the native batch engine (``native/secp256k1/`` via
+``crypto/native.py``), and the pure-Python tier
+(``crypto/fallback.py``) everywhere.  Receive-side hot paths
+additionally coalesce into batch drains (``crypto/batch.py``) whose
+dispatcher walks its own breaker-supervised rung ladder
+tpu -> native -> pure — the accelerator rung lives in
+``crypto/tpu.py`` over ``ops/secp256k1_pallas.py`` (docs/crypto.md,
 docs/ingest.md):
 
 - ECIES (reference behavior: src/pyelliptic/ecc.py:461-501): ephemeral

@@ -9,9 +9,19 @@ connections — become the next batch, one executor hop and one
 GIL-releasing native call per drain, ``std::thread`` fan-out across
 items inside the library (native/secp256k1/).
 
-Tiers, breaker-supervised like the PoW ladder (pow/dispatcher.py):
+Tiers, breaker-supervised like the PoW ladder (pow/dispatcher.py) and
+walked IN ORDER — a failure on one rung lands on the next, never skips
+it (ISSUE 13: tpu -> native -> pure):
 
-1. **native** — ``tpu_secp_verify_batch`` for ECDSA (scalar prep
+1. **tpu** — the accelerator-resident batch engine (``crypto/tpu.py``
+   over ``ops/secp256k1_pallas.py``): the whole drain runs as one SIMD
+   program, one lane per check.  Consulted only for drains of at least
+   ``tpu_batch_min`` items (smaller drains are not worth a device
+   launch) and supervised by its own breaker at the ``crypto.tpu``
+   chaos site; failures count into ``crypto_tpu_fallback_total`` and
+   fall to native.  Scalar prep is SHARED with the native tier — both
+   consume the same ``verify_prepared``/``ecdh_batch`` drain ABI.
+2. **native** — ``tpu_secp_verify_batch`` for ECDSA (scalar prep
    u1 = e/s, u2 = r/s stays in Python; digest order follows the
    per-pubkey hint table in ``crypto/signing.py``) and
    ``tpu_secp_ecdh_batch`` for ECIES, which fans one object's
@@ -22,16 +32,17 @@ Tiers, breaker-supervised like the PoW ladder (pow/dispatcher.py):
    early-exit (an object is encrypted to exactly one key) while
    amortizing calls across objects.  MAC-first rejection: AES runs
    only for the one real match.
-2. **pure** — the per-item ``crypto.signing`` / ``crypto.ecies``
+3. **pure** — the per-item ``crypto.signing`` / ``crypto.ecies``
    ladder (OpenSSL-backed ``cryptography`` when installed, else
    pure Python), fanned across a small thread pool.  Entered when the
    native library is unbuilt, its breaker is open, or the attempt
    raises — including the ``crypto.native`` chaos site — and counted
    in ``crypto_native_fallback_total``.  No check is ever lost to a
-   native failure.
+   backend failure.
 
 Parity between the tiers is property-tested bit-for-bit
-(tests/test_crypto_batch.py).
+(tests/test_crypto_batch.py, tests/test_crypto_tpu.py); the ladder,
+limb representation and tuning knobs are documented in docs/crypto.md.
 """
 
 from __future__ import annotations
@@ -70,6 +81,10 @@ NATIVE_FALLBACKS = REGISTRY.counter(
     "crypto_native_fallback_total",
     "Drains whose native batch attempt failed and re-ran on the pure "
     "per-item tier (breaker-counted; no check is lost)")
+TPU_FALLBACKS = REGISTRY.counter(
+    "crypto_tpu_fallback_total",
+    "Drains whose accelerator batch attempt failed and walked down to "
+    "the native rung (breaker-counted; no check is lost)")
 SHUTDOWN_SETTLED = REGISTRY.counter(
     "crypto_batch_shutdown_settled_total",
     "Checks still pending at engine shutdown, settled deterministically "
@@ -108,24 +123,38 @@ class BatchCryptoEngine:
     fan-out only pays off when spare cores actually exist — on a
     2-core box the event loop and ingest workers already own them.
     Raise it on wide hosts.
+
+    ``use_tpu=False`` pins the accelerator rung off (the ``cryptotpu``
+    knob); with it on, availability still follows ``crypto/tpu.py``'s
+    probe/mode/force-disable state.  ``tpu_batch_min`` is the minimum
+    drain size (verify checks + trial-decrypt objects) worth a device
+    launch — smaller drains start at the native rung
+    (``cryptotpubatchmin``; docs/crypto.md discusses tuning).
     """
 
     def __init__(self, *, use_native: bool = True, window: float = 0.0,
-                 num_threads: int = 1,
+                 num_threads: int = 1, use_tpu: bool = True,
+                 tpu_batch_min: int = 64,
                  breaker: CircuitBreaker | None = None):
         self.use_native = use_native
+        self.use_tpu = use_tpu
+        self.tpu_batch_min = tpu_batch_min
         self.window = window
         self.num_threads = num_threads
         self.queue: asyncio.Queue = asyncio.Queue()
         self.breaker = breaker or CircuitBreaker(
             "crypto.native", threshold=3, cooldown=60.0)
+        self.tpu_breaker = CircuitBreaker(
+            "crypto.tpu", threshold=3, cooldown=60.0)
         self._task: asyncio.Task | None = None
         self._exec: ThreadPoolExecutor | None = None
         self._fan: ThreadPoolExecutor | None = None
         self._lock = threading.Lock()
-        #: observability: items down each path
+        #: observability: items down each path + the last rung used
+        self.tpu_items = 0
         self.native_items = 0
         self.pure_items = 0
+        self.last_path: str | None = None
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -140,6 +169,9 @@ class BatchCryptoEngine:
             # must not run on the event loop — loading here means
             # loop-side callers (keystore, API) find it ready
             self._executor().submit(self._native_engine)
+        if self.use_tpu:
+            # same for the tpu rung: the probe imports JAX (seconds)
+            self._executor().submit(self._tpu_engine)
         self._task = asyncio.create_task(self._run())
         return self._task
 
@@ -265,31 +297,68 @@ class BatchCryptoEngine:
         native = get_native()
         return native if native.available else None
 
+    def _tpu_engine(self):
+        if not self.use_tpu:
+            return None
+        from .tpu import get_tpu
+        tpu = get_tpu()
+        return tpu if tpu.available else None
+
+    def _run_tier(self, path: str, backend, verifies, decrypts,
+                  breaker: CircuitBreaker):
+        """One batch-backend attempt (tpu or native): both rungs speak
+        the same ``verify_prepared``/``ecdh_batch`` drain ABI, so the
+        scalar prep, digest-hint rounds and wavefront sweep are shared
+        code — parity between the rungs is structural."""
+        t0 = time.monotonic()
+        v_res = self._backend_verify(backend, verifies)
+        tv = time.monotonic()
+        d_res = self._backend_decrypt(backend, decrypts)
+        if verifies:
+            BATCH_SECONDS.labels(op="verify").observe(tv - t0)
+        if decrypts:
+            BATCH_SECONDS.labels(op="decrypt").observe(
+                time.monotonic() - tv)
+        breaker.record_success()
+        setattr(self, path + "_items",
+                getattr(self, path + "_items")
+                + len(verifies) + len(decrypts))
+        self._count(verifies, decrypts, path)
+        self.last_path = path
+        return v_res, d_res
+
     def _execute(self, verifies, decrypts):
         """One drain's work; returns (verify bools, decrypt matches).
 
-        Runs on the dispatch thread — the native tier releases the GIL
-        for the whole batch, the pure tier fans across ``_fanout``.
+        Runs on the dispatch thread — a proper LADDER WALK: the tpu
+        rung (when the drain is big enough), then native, then pure.
+        A failed rung falls to the NEXT one, never skips it (the
+        pre-ISSUE-13 code jumped straight from the failed tier to
+        pure, wasting a healthy native library).  The tpu/native
+        rungs release the GIL for the whole batch; the pure tier fans
+        across ``_fanout``.
         """
+        drain = len(verifies) + len(decrypts)
+        tpu = (self._tpu_engine()
+               if drain >= self.tpu_batch_min else None)
+        if tpu is not None and self.tpu_breaker.allow():
+            try:
+                inject("crypto.tpu")
+                return self._run_tier("tpu", tpu, verifies, decrypts,
+                                      self.tpu_breaker)
+            except Exception:
+                self.tpu_breaker.record_failure()
+                ERRORS.labels(site="crypto.tpu").inc()
+                TPU_FALLBACKS.inc()
+                logger.exception(
+                    "tpu crypto batch failed; walking down to the "
+                    "native rung")
         native = self._native_engine()
-        path = "pure"
         if native is not None and self.breaker.allow():
             try:
                 inject("crypto.native")
-                t0 = time.monotonic()
-                v_res = self._native_verify(native, verifies)
-                tv = time.monotonic()
-                d_res = self._native_decrypt(native, decrypts)
-                if verifies:
-                    BATCH_SECONDS.labels(op="verify").observe(
-                        tv - t0)
-                if decrypts:
-                    BATCH_SECONDS.labels(op="decrypt").observe(
-                        time.monotonic() - tv)
-                self.breaker.record_success()
-                self.native_items += len(verifies) + len(decrypts)
-                self._count(verifies, decrypts, "native")
-                return v_res, d_res
+                return self._run_tier("native", native, verifies,
+                                      decrypts, self.breaker)
             except Exception:
                 self.breaker.record_failure()
                 ERRORS.labels(site="crypto.native").inc()
@@ -307,7 +376,8 @@ class BatchCryptoEngine:
             BATCH_SECONDS.labels(op="decrypt").observe(
                 time.monotonic() - tv)
         self.pure_items += len(verifies) + len(decrypts)
-        self._count(verifies, decrypts, path)
+        self._count(verifies, decrypts, "pure")
+        self.last_path = "pure"
         return v_res, d_res
 
     @staticmethod
@@ -364,11 +434,13 @@ class BatchCryptoEngine:
             k -= 1
         return out
 
-    def _native_verify(self, native, verifies) -> list[bool]:
+    def _backend_verify(self, backend, verifies) -> list[bool]:
         """Batch ECDSA with hinted-digest rounds: round 1 tries each
         item's preferred digest; only misses re-enter round 2 with the
         alternate — legacy-SHA1 peers stop paying a doomed SHA256
-        scalar multiplication once the hint table warms."""
+        scalar multiplication once the hint table warms.  ``backend``
+        is any object speaking the ``verify_prepared`` drain ABI (the
+        native library or the tpu rung)."""
         results = [False] * len(verifies)
         if not verifies:
             return results
@@ -389,7 +461,7 @@ class BatchCryptoEngine:
                 pubs.append(point)
                 rs.append(r.to_bytes(32, "big"))
                 idx.append((i, d_i))
-            ok = native.verify_prepared(
+            ok = backend.verify_prepared(
                 len(idx), b"".join(u1s), b"".join(u2s),
                 b"".join(pubs), b"".join(rs),
                 nthreads=self.num_threads)
@@ -404,7 +476,7 @@ class BatchCryptoEngine:
             live = nxt
         return results
 
-    def _native_decrypt(self, native, decrypts):
+    def _backend_decrypt(self, backend, decrypts):
         """Wavefront trial decryption: round k computes ECDH for the
         k-th candidate of every still-unmatched object in ONE native
         call, then MAC-checks; AES runs only for the real match."""
@@ -434,9 +506,9 @@ class BatchCryptoEngine:
                 scalars.append(scalar)
                 idx.append(i)
             if idx:
-                xs = native.ecdh_batch(len(idx), b"".join(points),
-                                       b"".join(scalars),
-                                       nthreads=self.num_threads)
+                xs = backend.ecdh_batch(len(idx), b"".join(points),
+                                        b"".join(scalars),
+                                        nthreads=self.num_threads)
             else:
                 xs = []
             nxt = set(live)

@@ -12,7 +12,10 @@ from __future__ import annotations
 
 import functools
 import hashlib
+import logging
 import secrets
+
+logger = logging.getLogger("pybitmessage_tpu.crypto")
 
 try:
     from cryptography.hazmat.primitives.asymmetric import ec
@@ -212,6 +215,32 @@ def priv_to_pub(privkey: bytes) -> bytes:
         return b"\x04" + out
     from . import fallback
     return fallback.priv_to_pub(privkey)
+
+
+def priv_to_pub_many(privkeys: list[bytes]) -> list[bytes]:
+    """Batch key derivation: one accelerator ``base_mult_batch`` drain
+    when the tpu rung is up and the batch is launch-worthy (ISSUE 13 —
+    bulk address grinding / bench shapes), else the per-key ladder.
+    Raises ValueError on any out-of-range scalar, like
+    :func:`priv_to_pub`.  A device-side failure falls back to the
+    per-key ladder — never surfaces to the caller."""
+    from .tpu import get_tpu
+    tpu = get_tpu()
+    if len(privkeys) >= 16 and tpu.available:
+        # priv_scalar32 raises the accurate ValueError for any
+        # out-of-range key BEFORE the device is involved
+        scalars = b"".join(priv_scalar32(k) for k in privkeys)
+        try:
+            pts = tpu.base_mult_batch(scalars, len(privkeys))
+        except Exception:
+            from ..resilience.policy import ERRORS
+            ERRORS.labels(site="crypto.tpu").inc()
+            logger.exception("tpu base_mult_batch failed; deriving "
+                             "keys on the per-key ladder")
+            pts = None
+        if pts is not None and all(p is not None for p in pts):
+            return [b"\x04" + p for p in pts]
+    return [priv_to_pub(k) for k in privkeys]
 
 
 # --- 0x02CA curve-tagged wire format ---------------------------------------

@@ -1,0 +1,348 @@
+"""Accelerator-resident batch secp256k1 — the top rung of the
+receive-side crypto ladder (ISSUE 13).
+
+Mirrors ``crypto/native.py``'s binding contract exactly, so
+``crypto/batch.py`` drives either backend through one drain shape:
+
+- ``verify_prepared(n, u1s, u2s, pubs, rs)`` — batch ECDSA acceptance
+  over host-prepared scalars (the Montgomery-batched s^-1 prep and the
+  digest-hint rounds stay in ``crypto/batch.py``, shared by all tiers);
+- ``ecdh_batch(n, points, scalars)`` — the wavefront trial-decrypt
+  round: one ECDH per still-unmatched object per round;
+- ``base_mult`` / ``base_mult_batch`` — fixed-base scalar
+  multiplication (key derivation, address grinding).
+
+The math lives in ``ops/secp256k1_pallas.py`` (20x13-bit lazy-carry
+limbs, branchless Jacobian ladders); this module is the probe/pack/
+dispatch layer:
+
+- **lazy probe** — JAX is imported on first use, never at module
+  import; a failed probe degrades to unavailable exactly like an
+  unbuildable native library.
+- **mode** — ``configure("auto"|"on"|"off")`` from the ``cryptotpu``
+  knob (env override ``BMTPU_CRYPTO_TPU`` for bench/test
+  subprocesses): ``auto`` enables the rung only on a real TPU backend
+  (a CPU host gains nothing from XLA-on-CPU drains vs the native
+  library), ``on`` forces it on whatever backend JAX has — the CPU-CI
+  parity path — and ``off`` disables the probe entirely.
+- **force-disable** — ``set_tpu_enabled(False)`` is the process-wide
+  kill switch (the ``set_native_enabled`` twin) for parity tests and
+  the honest bench baseline.
+- **kernel selection** — on a TPU backend the Pallas kernels run; on
+  anything else the same core functions run under plain ``jax.jit``
+  (the interpret/XLA path CPU CI exercises).
+
+Failure supervision (breaker, ``crypto.tpu`` chaos site,
+``crypto_tpu_fallback_total``) lives in the drain dispatcher
+(crypto/batch.py), keeping this module a pure backend like its native
+twin.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+
+logger = logging.getLogger("pybitmessage_tpu.crypto")
+
+_P = 0xFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFEFFFFFC2F
+_N = 0xFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFEBAAEDCE6AF48A03BBFD25E8CD0364141
+
+#: process-wide force-disable switch (the ``set_native_enabled`` twin)
+_FORCE_DISABLED = False
+
+#: rung mode: "auto" (TPU backend only) | "on" | "off"
+_MODE = "auto"
+
+
+def set_tpu_enabled(enabled: bool) -> None:
+    globals()["_FORCE_DISABLED"] = not enabled
+
+
+def tpu_enabled() -> bool:
+    return not _FORCE_DISABLED
+
+
+def configure(mode: str) -> None:
+    """Set the rung mode from the ``cryptotpu`` knob.  Accepts the
+    boolean spellings too (``true``/``1`` -> on, ``false``/``0`` ->
+    off) so CLI flags read naturally."""
+    mode = mode.strip().lower()
+    if mode in ("on", "true", "1", "yes"):
+        mode = "on"
+    elif mode in ("off", "false", "0", "no"):
+        mode = "off"
+    elif mode != "auto":
+        raise ValueError("cryptotpu mode must be auto/on/off, got %r"
+                         % mode)
+    globals()["_MODE"] = mode
+
+
+def mode() -> str:
+    return _MODE
+
+
+class TpuSecp:
+    """Batch secp256k1 on the accelerator (or its XLA shadow).
+
+    The probe runs once, lazily: importing JAX, reading the backend
+    platform, and compiling nothing.  Kernels compile per lane bucket
+    on first use (``ops.secp256k1_pallas.BUCKETS``); drains larger
+    than the top bucket chunk into several launches.
+    """
+
+    def __init__(self):
+        self._probed = False
+        self._ok = False
+        self._platform: str | None = None
+        self._use_pallas = False
+        self._lock = threading.Lock()
+
+    # -- probe ---------------------------------------------------------------
+
+    def _probe(self) -> bool:
+        with self._lock:
+            if self._probed:
+                return self._ok
+            self._probed = True
+            if _MODE == "off":
+                logger.info("crypto tpu rung disabled (cryptotpu=off)")
+                return False
+            try:
+                import jax
+                self._platform = jax.default_backend()
+            except Exception as exc:
+                from ..resilience.policy import ERRORS
+                ERRORS.labels(site="crypto.tpu_probe").inc()
+                logger.warning("crypto tpu rung unavailable: %r", exc)
+                return False
+            self._use_pallas = self._platform == "tpu"
+            if _MODE == "auto" and not self._use_pallas:
+                logger.info(
+                    "crypto tpu rung idle: backend is %r (cryptotpu="
+                    "auto enables it on TPU only; set cryptotpu=on to "
+                    "force the XLA path)", self._platform)
+                return False
+            logger.info("crypto tpu rung ready: %s backend (%s path)",
+                        self._platform,
+                        "pallas" if self._use_pallas else "xla")
+            self._ok = True
+            return True
+
+    @property
+    def available(self) -> bool:
+        return not _FORCE_DISABLED and self._probe()
+
+    @property
+    def probed(self) -> bool:
+        return self._probed
+
+    @property
+    def platform(self) -> str | None:
+        return self._platform
+
+    def _require(self):
+        if not self.available:
+            raise RuntimeError("crypto tpu rung unavailable")
+        from ..ops import secp256k1_pallas as ops
+        return ops
+
+    # -- batch entry points (the NativeSecp drain ABI) -----------------------
+
+    def verify_prepared(self, n: int, u1s: bytes, u2s: bytes,
+                        pubs: bytes, rs: bytes,
+                        nthreads: int | None = None) -> list[bool]:
+        """Batch ECDSA acceptance over pre-reduced scalars; packing and
+        semantics identical to ``NativeSecp.verify_prepared``
+        (``nthreads`` is accepted for ABI parity and ignored — lane
+        parallelism replaces thread fan-out)."""
+        ops = self._require()
+        if not (len(u1s) == len(u2s) == len(rs) == 32 * n
+                and len(pubs) == 64 * n):
+            raise ValueError("bad verify batch packing")
+        if n == 0:
+            return []
+        # host-side coordinate/range screen, mirroring the native
+        # loader: out-of-field coordinates or r not in [1, n-1] are
+        # simply False (the device reduces mod p and cannot tell)
+        valid = []
+        for i in range(n):
+            x = int.from_bytes(pubs[64 * i:64 * i + 32], "big")
+            y = int.from_bytes(pubs[64 * i + 32:64 * i + 64], "big")
+            r = int.from_bytes(rs[32 * i:32 * i + 32], "big")
+            valid.append(x < _P and y < _P and 0 < r < _N)
+        u1w = ops.bytes_to_words(u1s, n)
+        u2w = ops.bytes_to_words(u2s, n)
+        qx = ops.bytes_to_limbs(
+            b"".join(pubs[64 * i:64 * i + 32] for i in range(n)), n)
+        qy = ops.bytes_to_limbs(
+            b"".join(pubs[64 * i + 32:64 * i + 64] for i in range(n)), n)
+        rl = ops.bytes_to_limbs(rs, n)
+        ok = self._run_lanes(
+            lambda args: self._verify_lanes(ops, args),
+            [u1w, u2w, qx, qy, rl], n)
+        return [bool(ok[i]) and valid[i] for i in range(n)]
+
+    def ecdh_batch(self, n: int, points: bytes, scalars: bytes,
+                   nthreads: int | None = None) -> list[bytes | None]:
+        """Batch ECDH; packing and semantics identical to
+        ``NativeSecp.ecdh_batch`` (None for an invalid point or
+        scalar)."""
+        ops = self._require()
+        if not (len(points) == 64 * n and len(scalars) == 32 * n):
+            raise ValueError("bad ecdh batch packing")
+        if n == 0:
+            return []
+        valid = []
+        for i in range(n):
+            x = int.from_bytes(points[64 * i:64 * i + 32], "big")
+            y = int.from_bytes(points[64 * i + 32:64 * i + 64], "big")
+            k = int.from_bytes(scalars[32 * i:32 * i + 32], "big")
+            valid.append(x < _P and y < _P and 0 < k < _N)
+        kw = ops.bytes_to_words(scalars, n)
+        px = ops.bytes_to_limbs(
+            b"".join(points[64 * i:64 * i + 32] for i in range(n)), n)
+        py = ops.bytes_to_limbs(
+            b"".join(points[64 * i + 32:64 * i + 64] for i in range(n)),
+            n)
+        xs, ok = self._run_lanes(
+            lambda args: self._ecdh_lanes(ops, args), [kw, px, py], n,
+            two_outputs=True)
+        out: list[bytes | None] = []
+        for i in range(n):
+            out.append(xs[i] if (ok[i] and valid[i]) else None)
+        return out
+
+    def base_mult_batch(self, scalars: bytes, n: int) \
+            -> list[bytes | None]:
+        """n scalars -> n 64-byte X||Y points (None out of range)."""
+        ops = self._require()
+        if len(scalars) != 32 * n:
+            raise ValueError("bad base mult packing")
+        if n == 0:
+            return []
+        valid = [0 < int.from_bytes(scalars[32 * i:32 * i + 32], "big")
+                 < _N for i in range(n)]
+        kw = ops.bytes_to_words(scalars, n)
+        xys, ok = self._base_lanes(ops, kw, n)
+        return [xys[i] if (ok[i] and valid[i]) else None
+                for i in range(n)]
+
+    def base_mult(self, scalar: bytes) -> bytes | None:
+        """scalar * G -> 64-byte X||Y (the single-item NativeSecp
+        spelling; batch callers use ``base_mult_batch``)."""
+        return self.base_mult_batch(scalar, 1)[0]
+
+    # -- lane execution ------------------------------------------------------
+
+    def _run_lanes(self, fn, arrays, n, *, two_outputs: bool = False):
+        """Chunk a drain into lane buckets and concatenate results."""
+        from ..ops import secp256k1_pallas as ops
+        top = ops.BUCKETS[-1]
+        if n <= top:
+            return fn([a[..., :n] for a in arrays])
+        outs = [fn([a[..., s:s + top] for a in arrays])
+                for s in range(0, n, top)]
+        if two_outputs:
+            return ([x for o in outs for x in o[0]],
+                    [x for o in outs for x in o[1]])
+        return [x for o in outs for x in o]
+
+    def _lane_count(self, ops, n: int) -> int:
+        """Pallas tiles are (8, 128) lanes; the XLA path pads to the
+        jit-cache buckets instead."""
+        if self._use_pallas:
+            return -(-n // ops.TILE) * ops.TILE
+        return ops.bucket_for(n)
+
+    def _verify_lanes(self, ops, args) -> list[bool]:
+        import numpy as np
+        n = args[0].shape[-1]
+        padded = [ops.pad_lanes(a, self._lane_count(ops, n))
+                  for a in args]
+        if self._use_pallas:
+            tiled = [a.reshape(a.shape[0], -1, ops.LANE_ROWS,
+                               ops.LANE_COLS) for a in padded]
+            ok = np.asarray(ops.pallas_verify(*tiled)).reshape(-1)
+        else:
+            ok = np.asarray(ops.xla_verify(*padded))
+        return [bool(ok[i]) for i in range(n)]
+
+    def _ecdh_lanes(self, ops, args, *, want_y: bool = False):
+        import numpy as np
+        n = args[0].shape[-1]
+        padded = [ops.pad_lanes(a, self._lane_count(ops, n))
+                  for a in args]
+        if self._use_pallas:
+            tiled = [a.reshape(a.shape[0], -1, ops.LANE_ROWS,
+                               ops.LANE_COLS) for a in padded]
+            x, y, ok = ops.pallas_ecdh(*tiled)
+            x = np.asarray(x).reshape(ops.LIMBS, -1)
+            y = np.asarray(y).reshape(ops.LIMBS, -1)
+            ok = np.asarray(ok).reshape(-1)
+        else:
+            x, y, ok = ops.xla_ecdh(*padded)
+            x, y, ok = np.asarray(x), np.asarray(y), np.asarray(ok)
+        xs = ops.limbs_to_bytes(x[:, :n])
+        if want_y:
+            ys = ops.limbs_to_bytes(y[:, :n])
+            xs = [xb + yb for xb, yb in zip(xs, ys)]
+        return xs, [bool(ok[i]) for i in range(n)]
+
+    def _base_lanes(self, ops, kw, n):
+        """Fixed-base mult rides the SAME compiled program as ECDH
+        with P = G broadcast (the y output exists anyway), so a
+        process never compiles a third drain program."""
+        import numpy as np
+        gx = np.tile(
+            np.array(ops.GX_LIMBS, dtype=np.uint32)[:, None], (1, n))
+        gy = np.tile(
+            np.array(ops.GY_LIMBS, dtype=np.uint32)[:, None], (1, n))
+        xys, ok = self._run_lanes(
+            lambda args: self._ecdh_lanes(ops, args, want_y=True),
+            [kw, gx, gy], n, two_outputs=True)
+        return xys, ok
+
+    def snapshot(self) -> dict:
+        """clientStatus block: probe state without forcing a probe."""
+        return {
+            "mode": _MODE,
+            "forceDisabled": _FORCE_DISABLED,
+            "probed": self._probed,
+            "available": self._ok and not _FORCE_DISABLED,
+            "platform": self._platform,
+            "kernel": ("pallas" if self._use_pallas else
+                       "xla" if self._ok else None),
+        }
+
+
+_ENGINE: TpuSecp | None = None
+_ENGINE_LOCK = threading.Lock()
+
+
+def get_tpu() -> TpuSecp:
+    """Process-wide engine (probe and kernel caches should run once)."""
+    global _ENGINE
+    with _ENGINE_LOCK:
+        if _ENGINE is None:
+            _ENGINE = TpuSecp()
+        return _ENGINE
+
+
+def reset_tpu() -> None:
+    """Drop the process-wide engine so the next ``get_tpu`` re-probes
+    (tests flip modes; a real node configures once at startup)."""
+    global _ENGINE
+    with _ENGINE_LOCK:
+        _ENGINE = None
+
+
+if os.environ.get("BMTPU_CRYPTO_TPU"):
+    try:
+        configure(os.environ["BMTPU_CRYPTO_TPU"])
+    except ValueError as exc:
+        # a typo'd env override must degrade (mode stays "auto"), not
+        # poison every importer — the config-file path still validates
+        # strictly through core/config.py
+        logger.warning("ignoring bad BMTPU_CRYPTO_TPU: %s", exc)

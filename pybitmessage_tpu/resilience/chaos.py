@@ -16,6 +16,7 @@ Site catalog (docs/resilience.md keeps the authoritative table):
 ``api.dispatch``       an RPC command dispatch (API server)
 ``sync.sketch_decode`` sketch subtract/peel (reconciler gossip/catch-up)
 ``crypto.native``      a native batch-crypto drain (``crypto/batch.py``)
+``crypto.tpu``         an accelerator batch-crypto drain (top ladder rung)
 ``storage.slab_io``    a slab drain/seal write (``storage/slabstore.py``)
 ``farm.accept``        a farm job submission accept (``powfarm/server.py``)
 ``farm.dispatch``      a farm batch launch through the solver ladder

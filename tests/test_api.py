@@ -324,6 +324,16 @@ def test_client_status(api_env):
         assert set(st["powVerify"]) == {"host", "device",
                                         "deviceBatches"}
         assert "powSolveRate" in st
+        # receive-side crypto ladder block (ISSUE 13): active rung,
+        # per-rung items, fallback counters, tpu probe snapshot
+        crypto = st["crypto"]
+        assert set(crypto) >= {"tpu", "fallbacks"}
+        assert crypto["tpu"]["mode"] in ("auto", "on", "off")
+        assert set(crypto["fallbacks"]) == {"tpu", "native", "digest"}
+        if "activeRung" in crypto:
+            assert crypto["activeRung"] in (None, "tpu", "native",
+                                            "pure")
+            assert set(crypto["items"]) == {"tpu", "native", "pure"}
     run_api_test(api_env, body)
 
 

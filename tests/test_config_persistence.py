@@ -76,6 +76,39 @@ def test_farm_knob_validators(tmp_path):
     assert s.getbool("powfarmauth")
 
 
+def test_crypto_tpu_knob_validators(tmp_path):
+    """ISSUE 13 satellite: the accelerator crypto-ladder knobs
+    (docs/crypto.md) — cryptotpu is a tri-state mode, the launch
+    floor is a bounded int."""
+    s = Settings(tmp_path / "settings.dat")
+    assert s.get("cryptotpu") == "auto"
+    assert s.getint("cryptotpubatchmin") == 64
+    for option, bad in [
+            ("cryptotpu", "maybe"),
+            ("cryptotpu", "pallas"),
+            ("cryptotpubatchmin", "0"),
+            ("cryptotpubatchmin", str(1 << 21)),
+            ("cryptotpubatchmin", "lots")]:
+        with pytest.raises(SettingsError):
+            s.set(option, bad)
+    for ok in ("auto", "on", "off", "true", "false"):
+        s.set("cryptotpu", ok)
+    s.set("cryptotpubatchmin", 256)
+    assert s.getint("cryptotpubatchmin") == 256
+    # every accepted spelling must be understood by the rung's
+    # configure() (the __main__ wiring path)
+    from pybitmessage_tpu.crypto import tpu as crypto_tpu
+    prev = crypto_tpu.mode()
+    try:
+        for ok, want in [("auto", "auto"), ("on", "on"),
+                         ("off", "off"), ("true", "on"),
+                         ("false", "off")]:
+            crypto_tpu.configure(ok)
+            assert crypto_tpu.mode() == want
+    finally:
+        crypto_tpu.configure(prev)
+
+
 def test_farm_tenant_table_parsing(tmp_path):
     """The powfarmtenants knob is the config path into signed-
     submissions mode: name:secret[:weight] comma list."""

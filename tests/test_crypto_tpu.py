@@ -1,0 +1,524 @@
+"""TPU-resident batch crypto tests (ISSUE 13).
+
+Parity of the accelerator rung (ops/secp256k1_pallas.py via
+crypto/tpu.py) against the pure-Python oracle (crypto/fallback.py):
+field arithmetic, group law, 1k-vector ECDSA verify and ECDH drains —
+bit-identical under JAX_PLATFORMS=cpu through the XLA path, which runs
+the same core functions the Pallas kernel bodies call.  Plus the
+ladder-walk mechanics: forced-fallback chaos parity (``crypto.tpu``
+armed at 100%% loses zero checks), the tpu -> native -> pure walk
+regression (a tpu failure lands on native, never skips it), the
+force-disable switch, the launch-worthiness floor, and the limb edge
+cases (p-1, carry-chain overflow, point at infinity, s^-1 batch
+inversion with a zero in the batch).
+
+Device programs compile per lane bucket; the suite deliberately packs
+every device-touching test into the 1024 bucket (parity batches are
+exactly 1024, engine tests pin ``BUCKETS`` to (1024,)) so the jit
+cache is shared and tier-1 pays each compile once.
+"""
+
+import asyncio
+import hashlib
+import random
+
+import pytest
+
+jax = pytest.importorskip("jax")
+import numpy as np  # noqa: E402
+
+from pybitmessage_tpu.crypto import encrypt, fallback, signing  # noqa: E402
+from pybitmessage_tpu.crypto import tpu as crypto_tpu  # noqa: E402
+from pybitmessage_tpu.crypto.batch import BatchCryptoEngine  # noqa: E402
+from pybitmessage_tpu.crypto.keys import (  # noqa: E402
+    priv_to_pub, priv_to_pub_many, random_private_key,
+)
+from pybitmessage_tpu.observability import REGISTRY  # noqa: E402
+from pybitmessage_tpu.ops import secp256k1_pallas as S  # noqa: E402
+from pybitmessage_tpu.resilience import CHAOS  # noqa: E402
+
+P, N = S.P, S.N
+rng = random.Random(20260804)
+
+
+def _sample(name, labels=None):
+    return REGISTRY.sample(name, labels) or 0.0
+
+
+@pytest.fixture(autouse=True)
+def _tpu_forced_on():
+    """Every test starts with the rung forced on (the CPU-CI parity
+    mode) and enabled; tests that flip modes/switches are isolated."""
+    crypto_tpu.configure("on")
+    crypto_tpu.set_tpu_enabled(True)
+    if not crypto_tpu.get_tpu().probed or \
+            not crypto_tpu.get_tpu().snapshot()["available"]:
+        crypto_tpu.reset_tpu()
+    yield
+    crypto_tpu.configure("auto")
+    crypto_tpu.set_tpu_enabled(True)
+    crypto_tpu.reset_tpu()
+
+
+def _to_bytes(vals):
+    return b"".join(v.to_bytes(32, "big") for v in vals)
+
+
+def _field_pack(vals):
+    return S.bytes_to_limbs(_to_bytes(vals), len(vals))
+
+
+def _field_unpack(arr):
+    return [int.from_bytes(b, "big") for b in S.limbs_to_bytes(arr)]
+
+
+# ---------------------------------------------------------------------------
+# limb codec + field arithmetic parity
+# ---------------------------------------------------------------------------
+
+def test_limb_codec_roundtrip():
+    vals = [0, 1, P - 1, 2**256 - 1, 2**255, 0x1FFF,
+            sum(0x1FFF << (13 * i) for i in range(20)) % 2**256]
+    vals += [rng.randrange(2**256) for _ in range(64)]
+    arr = _field_pack(vals)
+    assert arr.shape == (S.LIMBS, len(vals))
+    assert (arr[:-1] <= S.MASK).all()
+    assert _field_unpack(arr) == vals
+
+
+#: one lane count for every field-op test -> one jit cache entry each
+_FIELD_LANES = 1000
+
+
+def _field_case_vals(extra=()):
+    vals_a = [0, 1, P - 1, P - 2, P - 2**32 - 978, 2**255, 7]
+    vals_a += list(extra)
+    vals_a += [rng.randrange(P) for _ in range(_FIELD_LANES - len(vals_a))]
+    vals_b = [P - 1, P - 1, P - 1, 1, 12345, 2**255, P - 7]
+    vals_b += [rng.randrange(P) for _ in range(_FIELD_LANES - len(vals_b))]
+    return vals_a, vals_b
+
+
+def test_field_parity_1k_vectors():
+    """1000 random+edge vectors through mul/add/sub, bit-identical to
+    plain integer arithmetic mod p — including chained R*-form inputs
+    (the lazy-carry working form between canonicalizations)."""
+    vals_a, vals_b = _field_case_vals()
+    A, B = _field_pack(vals_a), _field_pack(vals_b)
+    mul = jax.jit(lambda a, b: S.f_canon(S.f_mul(a, b)))
+    assert _field_unpack(mul(A, B)) == [
+        a * b % P for a, b in zip(vals_a, vals_b)]
+    add = jax.jit(lambda a, b: S.f_canon(S.f_add(a, b)))
+    assert _field_unpack(add(A, B)) == [
+        (a + b) % P for a, b in zip(vals_a, vals_b)]
+    sub = jax.jit(lambda a, b: S.f_canon(S.f_sub(a, b)))
+    assert _field_unpack(sub(A, B)) == [
+        (a - b) % P for a, b in zip(vals_a, vals_b)]
+    # chained ops consume R* (possibly >= p, lazily carried) inputs
+    chain = jax.jit(
+        lambda a, b: S.f_canon(S.f_mul(S.f_mul(a, b), S.f_sub(b, a))))
+    assert _field_unpack(chain(A, B)) == [
+        (a * b % P) * ((b - a) % P) % P
+        for a, b in zip(vals_a, vals_b)]
+
+
+def test_field_carry_chain_overflow_edges():
+    """The adversarial carry shapes: maximal limbs everywhere
+    ((p-1)^2 folding), values straddling the 2^256 fold boundary, and
+    the all-8191-limb pattern that maximizes lazy-carry residue."""
+    dense = sum(0x1FFF << (13 * i) for i in range(19)) + (0x1FF << 247)
+    edges = [P - 1, dense % P, (2**256 - 1) % P, 2**256 - 2**32 - 978]
+    vals_a, vals_b = _field_case_vals(extra=edges)
+    A, B = _field_pack(vals_a), _field_pack(vals_b)
+    sq_chain = jax.jit(
+        lambda a, b: S.f_canon(S.f_mul(S.f_mul(a, a), S.f_mul(b, b))))
+    assert _field_unpack(sq_chain(A, B)) == [
+        pow(a, 2, P) * pow(b, 2, P) % P
+        for a, b in zip(vals_a, vals_b)]
+
+
+def test_field_inversion_parity():
+    vals = [1, 2, P - 1, P - 2, 2**128] + \
+        [rng.randrange(1, P) for _ in range(251)]
+    inv = jax.jit(lambda a: S.f_canon(S.f_inv(a)))
+    assert _field_unpack(inv(_field_pack(vals))) == [
+        pow(v, P - 2, P) for v in vals]
+
+
+# ---------------------------------------------------------------------------
+# group law + drain-op parity vs the pure oracle
+# ---------------------------------------------------------------------------
+
+def _verify_vectors(count):
+    """(u1, u2, Q, r, expected) ECDSA scalar vectors: valid signature
+    relations built from e = s*k - r*d, a corrupted slice, and the
+    adversarial group-law edges.
+
+    Construction walks R = k*G and Q = d*G INCREMENTALLY (one affine
+    add each per vector) so building 1k vectors costs ~1k group adds,
+    not ~2k full ladders; a sampled slice still runs the full pure
+    verifier to prove the construction identity itself.
+    """
+    def step(pt):
+        return fallback._jac_to_affine(
+            fallback._jac_add(fallback._as_jac(pt),
+                              (fallback.GX, fallback.GY, 1)))
+
+    k0 = rng.randrange(1, N - count)
+    d0 = rng.randrange(1, N - count)
+    R = fallback.point_mult(k0, (fallback.GX, fallback.GY))
+    Q = fallback.point_mult(d0, (fallback.GX, fallback.GY))
+    oracle_idx = set(rng.sample(range(count), min(48, count)))
+    items = []
+    for i in range(count):
+        k, d = k0 + i, d0 + i
+        r = R[0] % N
+        if r == 0:              # pragma: no cover - astronomically rare
+            r = 1
+        s = rng.randrange(1, N)
+        e = (s * k - r * d) % N
+        corrupted = i % 5 == 4
+        if corrupted:
+            e = (e + 1) % N     # corrupted: must fail on every tier
+        w = pow(s, -1, N)
+        u1, u2 = (e * w) % N, (r * w) % N
+        expected = not corrupted
+        if i in oracle_idx:     # the full pure oracle, sampled
+            assert fallback.ecdsa_verify_scalars(e, r, s, Q) \
+                == expected
+        items.append((u1, u2, Q, r, expected))
+        R, Q = step(R), step(Q)
+    # adversarial edges, in-batch so they share the compiled program:
+    two_g = fallback.point_mult(2, (S.GX, S.GY))
+    items[0] = (0, 0, items[0][2], items[0][3], False)   # infinity
+    items[1] = (1, 1, (S.GX, S.GY), two_g[0] % N, True)  # Q=G: Shamir
+    #                                                      G+Q doubling
+    items[2] = (1, 1, (S.GX, P - S.GY), 1, False)        # Q=-G: inf
+    items[3] = (items[3][0], items[3][1], items[3][2], 0, False)  # r=0
+    return items
+
+
+def test_verify_parity_1k():
+    """1024 ECDSA scalar verifications through the tpu rung,
+    bit-identical to the pure oracle (acceptance criterion)."""
+    items = _verify_vectors(1024)
+    n = len(items)
+    tpu = crypto_tpu.get_tpu()
+    assert tpu.available
+    oks = tpu.verify_prepared(
+        n, _to_bytes([it[0] for it in items]),
+        _to_bytes([it[1] for it in items]),
+        b"".join(it[2][0].to_bytes(32, "big")
+                 + it[2][1].to_bytes(32, "big") for it in items),
+        _to_bytes([it[3] for it in items]))
+    assert len(oks) == n
+    mismatches = [i for i in range(n) if oks[i] != items[i][4]]
+    assert not mismatches, mismatches[:10]
+    assert sum(oks) > 700       # the valid bulk actually verified
+
+
+def test_verify_rejects_wire_junk(monkeypatch):
+    """Host-screen parity with the native loader: off-curve points,
+    out-of-field coordinates and out-of-range r are simply False."""
+    monkeypatch.setattr(S, "BUCKETS", (1024,))  # reuse compiled bucket
+    d = rng.randrange(1, N)
+    q = fallback.base_mult(d)
+    good = (rng.randrange(1, N), rng.randrange(1, N))
+    u1s = _to_bytes([good[0]] * 4)
+    u2s = _to_bytes([good[1]] * 4)
+    pubs = b"".join([
+        q[0].to_bytes(32, "big") + (q[1] ^ 1).to_bytes(32, "big"),
+        P.to_bytes(32, "big") + q[1].to_bytes(32, "big"),
+        q[0].to_bytes(32, "big") + q[1].to_bytes(32, "big"),
+        q[0].to_bytes(32, "big") + q[1].to_bytes(32, "big"),
+    ])
+    rs = _to_bytes([1, 1, 0, N])
+    oks = crypto_tpu.get_tpu().verify_prepared(4, u1s, u2s, pubs, rs)
+    assert oks == [False, False, False, False]
+
+
+def test_ecdh_parity_1k():
+    """1024 ECDH rounds (the wavefront trial-decrypt shape: one
+    scalar x point each) bit-identical to the pure oracle, with
+    invalid entries None exactly like the native tier."""
+    ks, pts, want = [], [], []
+    pt = fallback.base_mult(rng.randrange(1, N - 1024))
+    for i in range(1024):
+        k = rng.randrange(1, N)
+        ks.append(k)
+        pts.append(pt)
+        want.append(fallback.ecdh_x(
+            k.to_bytes(32, "big"), fallback.encode_point(*pt)))
+        pt = fallback._jac_to_affine(fallback._jac_add(
+            fallback._as_jac(pt), (fallback.GX, fallback.GY, 1)))
+    # in-batch invalid entries: zero scalar, over-order scalar,
+    # off-curve point — None, without disturbing neighbors
+    ks[0] = 0
+    ks[1] = N
+    pts[2] = (pts[2][0], pts[2][1] ^ 1)
+    want[0] = want[1] = want[2] = None
+    tpu = crypto_tpu.get_tpu()
+    out = tpu.ecdh_batch(
+        1024,
+        b"".join(p[0].to_bytes(32, "big") + p[1].to_bytes(32, "big")
+                 for p in pts),
+        _to_bytes(ks))
+    assert out == want
+
+
+def test_base_mult_batch_parity(monkeypatch):
+    # base mult rides the compiled ECDH program (P = G); pin the 1024
+    # bucket so no new program compiles
+    monkeypatch.setattr(S, "BUCKETS", (1024,))
+    ks = [1, 2, N - 1, N // 2] + \
+        [rng.randrange(1, N) for _ in range(252)]
+    tpu = crypto_tpu.get_tpu()
+    out = tpu.base_mult_batch(_to_bytes(ks), len(ks))
+    for k, got in zip(ks, out):
+        x, y = fallback.base_mult(k)
+        assert got == x.to_bytes(32, "big") + y.to_bytes(32, "big")
+    # out-of-range scalars are None (the NativeSecp contract)
+    assert tpu.base_mult(b"\x00" * 32) is None
+    assert tpu.base_mult(N.to_bytes(32, "big")) is None
+    assert tpu.base_mult((1).to_bytes(32, "big")) == \
+        S.GX.to_bytes(32, "big") + S.GY.to_bytes(32, "big")
+
+
+def test_priv_to_pub_many_tpu_rung(monkeypatch):
+    """The keys-layer batch derivation helper rides the rung and
+    agrees with the per-key ladder."""
+    monkeypatch.setattr(S, "BUCKETS", (1024,))
+    privs = [random_private_key() for _ in range(256)]
+    assert priv_to_pub_many(privs) == [priv_to_pub(k) for k in privs]
+
+
+# ---------------------------------------------------------------------------
+# s^-1 batch inversion edge (the Montgomery trick with a zero)
+# ---------------------------------------------------------------------------
+
+def test_prep_sigs_zero_s_does_not_poison_batch():
+    """A signature with s = 0 (or malformed DER) must become a None
+    slot without corrupting the other items' batched inversions."""
+    privs = [random_private_key() for _ in range(3)]
+    pubs = [priv_to_pub(p) for p in privs]
+    good = [signing.sign(b"msg %d" % i, privs[i]) for i in range(3)]
+    zero_s = fallback.der_encode_sig(12345, 0)
+
+    class _Job:
+        def __init__(self, sig, pub):
+            self.data, self.sig, self.pub = b"x", sig, pub
+
+    jobs = [_Job(good[0], pubs[0]), _Job(zero_s, pubs[1]),
+            _Job(good[1], pubs[1]), _Job(b"junk", pubs[2]),
+            _Job(good[2], pubs[2])]
+    eng = BatchCryptoEngine()
+    out = eng._prep_sigs(jobs)
+    assert out[1] is None and out[3] is None
+    for i, sig in ((0, good[0]), (2, good[1]), (4, good[2])):
+        r, s = fallback.der_decode_sig(sig)
+        point, r_got, s_inv = out[i]
+        assert r_got == r
+        assert s_inv == pow(s, -1, N)
+
+
+# ---------------------------------------------------------------------------
+# engine integration: the tpu rung serving real drains
+# ---------------------------------------------------------------------------
+
+def _engine_vectors():
+    privs = [random_private_key() for _ in range(2)]
+    pubs = [priv_to_pub(p) for p in privs]
+    sigs = [(b"tpu drain %d" % i,
+             signing.sign(b"tpu drain %d" % i, privs[i % 2]),
+             pubs[i % 2]) for i in range(6)]
+    sigs.append((b"corrupt", sigs[0][1], pubs[0]))  # must fail
+    payloads = [encrypt(b"drain body %d" % i, pubs[i % 2])
+                for i in range(3)]
+    payloads.append(encrypt(b"foreign", priv_to_pub(random_private_key())))
+    candidates = [(p, i) for i, p in enumerate(privs)]
+    return sigs, payloads, candidates
+
+
+async def _run_engine(eng, sigs, payloads, candidates):
+    eng.start()
+    try:
+        return await asyncio.gather(
+            *[eng.verify(*v) for v in sigs],
+            *[eng.try_decrypt(pl, candidates) for pl in payloads])
+    finally:
+        await eng.stop()
+
+
+def test_engine_drains_through_tpu_rung(monkeypatch):
+    """End-to-end: the engine's verify + wavefront-decrypt drains land
+    on the tpu rung (lane-padded into the already-compiled 1024
+    bucket) and answer identically to the pure tier."""
+    monkeypatch.setattr(S, "BUCKETS", (1024,))
+    sigs, payloads, candidates = _engine_vectors()
+
+    eng = BatchCryptoEngine(use_native=False, use_tpu=True,
+                            tpu_batch_min=1, window=0.05)
+    got = asyncio.run(_run_engine(eng, sigs, payloads, candidates))
+    assert eng.tpu_items > 0 and eng.last_path == "tpu"
+    assert got[:6] == [True] * 6 and got[6] is False
+    hits = [m for m in got[7:] if m]
+    assert len(hits) == 3 and all(
+        m[0][0].startswith(b"drain body") for m in hits)
+
+    pure = BatchCryptoEngine(use_native=False, use_tpu=False)
+    want = asyncio.run(_run_engine(pure, sigs, payloads, candidates))
+    assert got == want          # bit-identical across rungs
+
+
+def test_forced_fallback_chaos_parity(monkeypatch):
+    """crypto.tpu chaos at 100%: every drain walks down the ladder,
+    zero checks lost, results bit-identical to the clean run
+    (acceptance criterion)."""
+    monkeypatch.setattr(S, "BUCKETS", (1024,))  # reuse compiled bucket
+    sigs, payloads, candidates = _engine_vectors()
+
+    def make():
+        return BatchCryptoEngine(use_tpu=True, tpu_batch_min=1,
+                                 window=0.05)
+
+    clean = asyncio.run(_run_engine(make(), sigs, payloads, candidates))
+    before = _sample("crypto_tpu_fallback_total")
+    CHAOS.seed(1234)
+    CHAOS.arm("crypto.tpu", probability=1.0)
+    try:
+        eng = make()
+        chaotic = asyncio.run(_run_engine(eng, sigs, payloads,
+                                          candidates))
+    finally:
+        CHAOS.disarm()
+    assert chaotic == clean                     # zero loss, bit-equal
+    assert _sample("crypto_tpu_fallback_total") > before
+    # the walk landed on a lower rung, not nowhere
+    assert eng.tpu_items == 0
+    assert eng.native_items + eng.pure_items > 0
+
+
+def test_ladder_walk_tpu_failure_lands_on_native():
+    """Regression (ISSUE 13 satellite): a tpu drain failure must walk
+    to the NATIVE rung, not jump straight to pure — the pre-fix
+    dispatcher re-ran the whole drain on the bottom tier."""
+    from pybitmessage_tpu.crypto.native import get_native
+    sigs, payloads, candidates = _engine_vectors()
+
+    class _Broken:
+        def verify_prepared(self, *a, **k):
+            raise RuntimeError("injected tpu failure")
+
+        def ecdh_batch(self, *a, **k):
+            raise RuntimeError("injected tpu failure")
+
+    eng = BatchCryptoEngine(use_tpu=True, tpu_batch_min=1, window=0.05)
+    eng._tpu_engine = lambda: _Broken()
+    before = _sample("crypto_tpu_fallback_total")
+    got = asyncio.run(_run_engine(eng, sigs, payloads, candidates))
+    assert got[:6] == [True] * 6 and got[6] is False
+    assert _sample("crypto_tpu_fallback_total") > before
+    if get_native().available:
+        assert eng.native_items > 0 and eng.pure_items == 0
+        assert eng.last_path == "native"
+    else:
+        assert eng.pure_items > 0 and eng.last_path == "pure"
+
+
+def test_tpu_breaker_opens_and_skips():
+    sigs, payloads, candidates = _engine_vectors()
+
+    async def main():
+        eng = BatchCryptoEngine(use_tpu=True, tpu_batch_min=1)
+        assert eng.tpu_breaker.threshold == 3
+        eng.start()
+        try:
+            CHAOS.arm("crypto.tpu", probability=1.0)
+            try:
+                for i in range(3):
+                    assert await eng.verify(*sigs[i]) is True
+            finally:
+                CHAOS.disarm()
+            assert eng.tpu_breaker.state == "open"
+            # breaker open: the tpu attempt is skipped entirely (no
+            # new fallback count) yet the drain still answers
+            before = _sample("crypto_tpu_fallback_total")
+            assert await eng.verify(*sigs[0]) is True
+            assert _sample("crypto_tpu_fallback_total") == before
+        finally:
+            await eng.stop()
+
+    asyncio.run(main())
+
+
+def test_force_disable_switch():
+    """set_tpu_enabled(False) is the process-wide kill switch: the
+    probed rung reports unavailable and the engine stays off it."""
+    tpu = crypto_tpu.get_tpu()
+    assert tpu.available
+    crypto_tpu.set_tpu_enabled(False)
+    try:
+        assert not tpu.available
+        sigs, payloads, candidates = _engine_vectors()
+        eng = BatchCryptoEngine(use_tpu=True, tpu_batch_min=1)
+        got = asyncio.run(_run_engine(eng, sigs[:2], [], candidates))
+        assert got == [True, True]
+        assert eng.tpu_items == 0
+    finally:
+        crypto_tpu.set_tpu_enabled(True)
+    assert tpu.available
+
+
+def test_mode_off_never_probes_jax():
+    crypto_tpu.configure("off")
+    crypto_tpu.reset_tpu()
+    tpu = crypto_tpu.get_tpu()
+    assert not tpu.available
+    assert tpu.snapshot()["mode"] == "off"
+    with pytest.raises(ValueError):
+        crypto_tpu.configure("bogus")
+
+
+def test_batch_min_floor_keeps_small_drains_native():
+    """Drains below cryptotpubatchmin stay off the device (a launch
+    costs more than a small native call)."""
+    sigs, payloads, candidates = _engine_vectors()
+    eng = BatchCryptoEngine(use_tpu=True, tpu_batch_min=500)
+    got = asyncio.run(_run_engine(eng, sigs[:3], payloads[:1],
+                                  candidates))
+    assert got[:3] == [True] * 3
+    assert eng.tpu_items == 0 and eng.last_path in ("native", "pure")
+
+
+# ---------------------------------------------------------------------------
+# Pallas kernel plumbing (interpret mode; full suite only)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_pallas_kernel_interpret_parity():
+    """The real kernels under ``interpret=True``: BlockSpec layout,
+    ref loads/stores and the unrolled-inversion kernel bodies produce
+    oracle-exact results.  A truncated ladder (static ``nbits``) keeps
+    interpret-mode cost tractable; the full-width math is covered by
+    the XLA-path tests above, which run the same core functions."""
+    n = 4
+    ks = [1, 2, 5, 2**8 - 1]
+    pts = [fallback.base_mult(rng.randrange(1, N)) for _ in range(n)]
+    kw = S.pad_lanes(S.bytes_to_words(_to_bytes(ks), n), S.TILE)
+    px = S.pad_lanes(_field_pack([p[0] for p in pts]), S.TILE)
+    py = S.pad_lanes(_field_pack([p[1] for p in pts]), S.TILE)
+    x, y, ok = S.pallas_ecdh(
+        kw.reshape(8, 1, S.LANE_ROWS, S.LANE_COLS),
+        px.reshape(S.LIMBS, 1, S.LANE_ROWS, S.LANE_COLS),
+        py.reshape(S.LIMBS, 1, S.LANE_ROWS, S.LANE_COLS),
+        nbits=8, interpret=True)
+    x = np.asarray(x).reshape(S.LIMBS, -1)
+    y = np.asarray(y).reshape(S.LIMBS, -1)
+    ok = np.asarray(ok).reshape(-1)
+    xs = S.limbs_to_bytes(x[:, :n])
+    ys = S.limbs_to_bytes(y[:, :n])
+    for i in range(n):
+        want = fallback.point_mult(ks[i], pts[i])
+        assert ok[i] == 1
+        assert xs[i] == want[0].to_bytes(32, "big")
+        assert ys[i] == want[1].to_bytes(32, "big")

@@ -357,11 +357,17 @@ def test_seeded_chaos_run_lands_in_flight_recorder_dump():
 
 
 @pytest.mark.asyncio
-async def test_no_object_loss_under_crypto_native_faults():
-    """ISSUE 7 acceptance: with the ``crypto.native`` chaos site at
+@pytest.mark.parametrize("sites", [
+    ("crypto.native",),
+    ("crypto.tpu", "crypto.native"),
+], ids=["native", "tpu_and_native"])
+async def test_no_object_loss_under_crypto_faults(sites):
+    """ISSUE 7 + ISSUE 13 acceptance: with the ``crypto.native`` (and,
+    in the second variant, also the ``crypto.tpu``) chaos site at
     100%% fire rate, every msg object still decrypts, verifies and
-    delivers through the pure-tier fallback — zero objects lost — and
-    ``crypto_native_fallback_total`` increments."""
+    delivers — the drain walks the WHOLE ladder tpu -> native -> pure
+    end to end with zero objects lost — and the per-rung fallback
+    counters increment."""
     from pybitmessage_tpu.crypto import encrypt, sign
     from pybitmessage_tpu.models import msgcoding
     from pybitmessage_tpu.models.constants import OBJECT_MSG
@@ -412,9 +418,19 @@ async def test_no_object_loss_under_crypto_native_faults():
         sender=SimpleNamespace(watched_acks=set(), needed_pubkeys={},
                                queue=asyncio.Queue()),
         min_ntpb=1, min_extra=1, write_behind=False)
+    from pybitmessage_tpu.crypto import tpu as crypto_tpu
+    tpu_armed = "crypto.tpu" in sites
+    if tpu_armed:
+        # force the rung into the walk (auto = idle on the CPU mesh);
+        # the chaos fault fires before any device work is attempted
+        crypto_tpu.configure("on")
+        crypto_tpu.reset_tpu()
+        proc.crypto.batch.tpu_batch_min = 1
     before = REGISTRY.sample("crypto_native_fallback_total") or 0
+    before_tpu = REGISTRY.sample("crypto_tpu_fallback_total") or 0
     CHAOS.seed(SEED)
-    CHAOS.arm("crypto.native", probability=1.0)
+    for site in sites:
+        CHAOS.arm(site, probability=1.0)
     try:
         proc.start()
         for p in payloads:
@@ -424,8 +440,13 @@ async def test_no_object_loss_under_crypto_native_faults():
         await proc.stop()
     finally:
         CHAOS.disarm()
+        if tpu_armed:
+            crypto_tpu.configure("auto")
+            crypto_tpu.reset_tpu()
     assert len(store.inbox()) == len(payloads), "objects lost"
     from pybitmessage_tpu.crypto.native import get_native
     if get_native().available:
         assert REGISTRY.sample("crypto_native_fallback_total") > before
+    if tpu_armed:
+        assert REGISTRY.sample("crypto_tpu_fallback_total") > before_tpu
     db.close()

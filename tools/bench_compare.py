@@ -81,6 +81,16 @@ GUARDS: list[tuple[str, str, float]] = [
     # catches the only actionable signal — the engine becoming
     # catastrophically slower than the per-call path it replaces
     ("configs.batch_crypto.batch_speedup", "atleast", 0.5),
+    # TPU-resident batch crypto (ISSUE 13): on CPU CI the tpu rung
+    # runs its XLA path, so the honest guarded figures are PARITY
+    # (host-verified sample + elementwise equality vs the native
+    # rung) and ZERO LOSS — both hard floors, not wall-clock bands.
+    # The real speedup target for a v5e chip (>=10x the native drain
+    # rate) is recorded in the bench JSON as
+    # batch_crypto.tpu_vs_native.target_speedup_v5e for the next
+    # hardware run.
+    ("configs.batch_crypto.tpu_vs_native.parity_ok", "atleast", 1.0),
+    ("configs.batch_crypto.tpu_vs_native.zero_loss", "atleast", 1.0),
     # zero-copy framing (ISSUE 11): bytes copied per payload byte is
     # machine-independent — the pre-PR join-and-allocate path measured
     # >= 2.0; the pooled path holds 1 + 1/dup_factor (~1.33).  The
