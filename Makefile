@@ -2,7 +2,8 @@
 # build + tox targets).  The C++ solver is also auto-built at runtime by
 # pybitmessage_tpu/pow/native.py when missing or stale.
 
-.PHONY: all native test bench bench-smoke chaos perfguard lint clean
+.PHONY: all native test bench bench-smoke chaos perfguard lint \
+	roles-smoke clean
 
 all: native
 
@@ -51,6 +52,14 @@ bench-smoke:
 #   python tools/bench_compare.py --run --update
 perfguard:
 	python tools/bench_compare.py --run
+
+# role-split smoke (docs/roles.md): spawn edge+relay as REAL daemon
+# subprocesses, deliver one message end to end over TCP through the
+# role IPC hand-off, assert the federation pane merges both roles and
+# that SIGTERM shuts both down cleanly.  CI-runnable, no TPU.
+roles-smoke: native
+	JAX_PLATFORMS=cpu python -m pytest tests/test_roles_smoke.py \
+		tests/test_roles.py -q
 
 clean:
 	$(MAKE) -C native/pow clean

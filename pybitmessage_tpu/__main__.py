@@ -105,10 +105,20 @@ async def run(args) -> int:
                 "lanes": settings.getint("powlanes"),
                 "chunks_per_call": settings.getint("powchunks")},
             stall_timeout=settings.getfloat("powstalltimeout"))
+    # composable roles (docs/roles.md): role/rolestreams/edgeprocs/
+    # roleipclisten/roleipcconnect select the deployment shape; the
+    # default ("all") is the fused single-process node
+    from .roles import parse_role_streams
+    role = settings.get("role")
     node = Node(args.data_dir,
                 solver=solver,
                 port=settings.getint("port"),
                 listen=not args.no_listen,
+                role=role,
+                role_streams=parse_role_streams(
+                    settings.get("rolestreams")) or None,
+                role_ipc_listen=settings.get("roleipclisten") or None,
+                role_ipc_connect=settings.get("roleipcconnect") or None,
                 test_mode=args.test_mode,
                 dandelion_enabled=settings.getint("dandelion") > 0,
                 tls_enabled=settings.getbool("tls"),
@@ -126,6 +136,10 @@ async def run(args) -> int:
                 farm_tenant=settings.get("powfarmtenant"),
                 farm_secret=settings.get("powfarmsecret"))
     node.settings = settings
+    # edgeprocs > 1: this listener shares its port via SO_REUSEPORT so
+    # sibling edge processes can bind alongside (docs/roles.md)
+    if settings.getint("edgeprocs") > 1:
+        node.pool.reuse_port = True
     node.dandelion.stem_probability = settings.getint("dandelion")
     node.processor.list_mode = settings.get("blackwhitelist")
     # observability knobs (docs/observability.md)

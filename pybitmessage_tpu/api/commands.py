@@ -745,6 +745,27 @@ class CommandHandler:
         out["enabled"] = True
         return json.dumps(out)
 
+    def cmd_roleStatus(self):
+        """Role-split deployment status (docs/roles.md): this node's
+        role, subscribed streams, per-stream peer overlay, inventory
+        size and the role IPC runtime snapshot — an edge's relay
+        links (outbox/acked/breaker), a relay's connected edges and
+        ingest counts.  The bench and the roles smoke test poll this
+        for end-to-end accepted-object counts."""
+        node = self.node
+        out = {
+            "role": getattr(node, "role", "all"),
+            "streams": list(node.ctx.streams),
+            "p2pListen": bool(node.listen),
+            "streamPeers": {str(s): n for s, n
+                            in node.pool.stream_overlay().items()},
+            "inventoryObjects": len(node.inventory),
+        }
+        runtime = getattr(node, "role_runtime", None)
+        if runtime is not None:
+            out["ipc"] = runtime.snapshot()
+        return json.dumps(out, indent=4)
+
     def cmd_dumpFlightRecorder(self, kind=""):
         """Dump the flight-recorder ring (ISSUE 6): the last N
         structured events — breaker flips, chaos fires, ladder

@@ -126,6 +126,25 @@ DEFAULTS: dict[str, str] = {
     "syncfanout": "-1",              # peers flooded immediately per
                                      # new object: -1 = auto sqrt(n),
                                      # 0 = pure reconciliation
+    # -- node roles (docs/roles.md) --
+    "role": "all",                   # all (fused single process) |
+                                     # edge (sockets/framing/PoW
+                                     # verify, hand-off over role IPC)
+                                     # | relay (storage/sync/process
+                                     # authority for a stream shard)
+    "rolestreams": "",               # comma list of stream numbers
+                                     # this process subscribes to
+                                     # (empty = stream 1)
+    "edgeprocs": "1",                # edge processes sharing the P2P
+                                     # listen port via SO_REUSEPORT
+                                     # (>1 also arms reuse_port on a
+                                     # fused node for rolling splits)
+    "roleipclisten": "",             # relay: serve role IPC on this
+                                     # "port" or "host:port"
+    "roleipcconnect": "",            # edge: relay endpoints, comma
+                                     # list of "host:port" (shard
+                                     # ownership learned dynamically
+                                     # from HELLO_ACK)
     # -- PoW solver farm (docs/pow_farm.md) --
     "powfarmlisten": "",             # serve PoW-as-a-service on this
                                      # "port" or "host:port" (empty =
@@ -267,6 +286,27 @@ def _validate_tenant_table(value: str) -> bool:
         return False
 
 
+def _validate_role_streams(value: str) -> bool:
+    from ..roles.registry import parse_role_streams
+    try:
+        parse_role_streams(value)
+        return True
+    except ValueError:
+        return False
+
+
+def _validate_endpoint_list(value: str) -> bool:
+    """Comma list of ``host:port`` (or bare ``port``) endpoints."""
+    for entry in value.split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        port = entry.rpartition(":")[2]
+        if not port.isdigit() or not 1 <= int(port) <= 65535:
+            return False
+    return True
+
+
 #: per-option validators (reference validate_<section>_<option>,
 #: bmconfigparser.py:142-158 — notably maxoutbound <= 8)
 VALIDATORS: dict[str, Callable[[str], bool]] = {
@@ -297,6 +337,13 @@ VALIDATORS: dict[str, Callable[[str], bool]] = {
     "syncenabled": _validate_bool,
     "syncinterval": _validate_float_range(0.5, 3600.0),
     "syncfanout": _validate_int_range(-1, 1000),
+    "role": lambda v: v in ("all", "edge", "relay"),
+    "rolestreams": _validate_role_streams,
+    "edgeprocs": _validate_int_range(1, 64),
+    "roleipclisten": lambda v: v == "" or (
+        v.rpartition(":")[2].isdigit()
+        and 0 <= int(v.rpartition(":")[2]) <= 65535),
+    "roleipcconnect": _validate_endpoint_list,
     "powfarmlisten": lambda v: v == "" or (
         v.rpartition(":")[2].isdigit()
         and 0 <= int(v.rpartition(":")[2]) <= 65535),

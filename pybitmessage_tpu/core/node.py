@@ -51,7 +51,18 @@ class Node:
                  farm_listen: str | None = None,
                  farm_connect: str | None = None,
                  farm_tenant: str = "default",
-                 farm_secret: str = ""):
+                 farm_secret: str = "",
+                 role: str = "all",
+                 role_streams: tuple[int, ...] | None = None,
+                 role_ipc_listen: str | None = None,
+                 role_ipc_connect: str | None = None):
+        #: composable roles (docs/roles.md): ``all`` is the fused
+        #: single-process node (default, today's behavior); ``edge``
+        #: and ``relay`` split the ingest and authority tiers into
+        #: separate processes sharded by stream
+        from ..roles import get_role
+        self.role = role
+        self.role_spec = spec = get_role(role)
         self.data_dir = Path(data_dir) if data_dir else None
         if self.data_dir:
             self.data_dir.mkdir(parents=True, exist_ok=True)
@@ -69,7 +80,12 @@ class Node:
         self.shutdown = asyncio.Event()
         self.db = Database(db_path)
         self.store = MessageStore(self.db)
-        if inventory_backend == "filesystem" and self.data_dir:
+        if not spec.owns_storage:
+            # edge role: a bounded dedupe/serve cache, no storage
+            # authority — the shard's relay owns the inventory
+            from ..roles.edge import EdgeCache
+            self.inventory = EdgeCache()
+        elif inventory_backend == "filesystem" and self.data_dir:
             # one-file-per-object backend (reference storage/filesystem.py,
             # the 'inventory.storage' config alternative)
             from ..storage.fs_inventory import FilesystemInventory
@@ -89,26 +105,35 @@ class Node:
         self.keystore = KeyStore(keys_path)
         self.knownnodes = KnownNodes(nodes_path)
         self.dandelion = Dandelion(enabled=dandelion_enabled)
+        #: dynamic stream assignment (docs/roles.md): ``role_streams``
+        #: is the shard this process subscribes to — a relay's
+        #: inventory/sync authority, an edge's accepted-stream set
+        streams = tuple(role_streams) if role_streams else (stream,)
         self.ctx = NodeContext(
             inventory=self.inventory, knownnodes=self.knownnodes,
-            dandelion=self.dandelion, streams=(stream,), port=port,
+            dandelion=self.dandelion, streams=streams, port=port,
             allow_private_peers=allow_private_peers,
             pow_ntpb=min_ntpb, pow_extra=min_extra,
             # test mode keeps the announce jitter but shrinks it so
             # multi-hop flows stay inside test timeouts
             announce_buckets=2 if test_mode else None)
         self.pool = ConnectionPool(self.ctx)
-        self.listen = listen
+        self.pool.reuse_port = spec.reuse_port
+        self.listen = listen and spec.listens_p2p
         #: set-reconciliation sync (docs/sync.md): sketch exchanges
-        #: replace most per-object inv flooding for NODE_SYNC peers
+        #: replace most per-object inv flooding for NODE_SYNC peers.
+        #: Edges don't reconcile — sync is shard (relay) authority.
         self.reconciler = None
         self.sync_digest = None
-        if sync_enabled:
+        if sync_enabled and spec.runs_sync:
             from ..models.constants import NODE_SYNC
             from ..sync import InventoryDigest, Reconciler
             digest = None
             if hasattr(self.inventory, "attach_digest"):
-                self.sync_digest = InventoryDigest()
+                # a sharded relay's digest is restricted to its own
+                # streams — the shard boundary (docs/roles.md)
+                self.sync_digest = InventoryDigest(
+                    streams=set(streams) if role == "relay" else None)
                 self.inventory.attach_digest(self.sync_digest)
                 digest = self.sync_digest
             self.reconciler = Reconciler(self.pool, digest=digest)
@@ -169,6 +194,19 @@ class Node:
             self.farm_server = FarmServer(
                 self.solver, journal=self.farm_journal,
                 host=fhost or "127.0.0.1", port=int(fport))
+
+        #: role IPC runtime (docs/roles.md): an edge's relay links or
+        #: a relay's IPC server; None for the fused node
+        self.role_runtime = None
+        if spec.forwards_ingest:
+            from ..roles.edge import EdgeRuntime
+            self.role_runtime = EdgeRuntime(self, role_ipc_connect or "")
+        elif spec.serves_ipc:
+            if not role_ipc_listen:
+                raise ValueError(
+                    "relay role needs roleipclisten (port or host:port)")
+            from ..roles.relay import RelayRuntime
+            self.role_runtime = RelayRuntime(self, role_ipc_listen)
 
         from .uisignal import UISignaler
         self.ui = UISignaler()
@@ -250,6 +288,8 @@ class Node:
         self.processor.start()
         self.cleaner.start()
         await self.pool.start(listen=self.listen)
+        if self.role_runtime is not None:
+            await self.role_runtime.start()
         if self.udp is not None:
             await self.udp.start()
         self._pump_task = asyncio.create_task(self._pump_objects())
@@ -266,10 +306,17 @@ class Node:
                     self.pool.listen_port if self.listen else "-")
 
     async def _pump_objects(self) -> None:
-        """Forward validated network objects to the processor."""
+        """Forward validated network objects to the processor — or,
+        on an edge, over role IPC to the stream's relay (the hand-off
+        awaits outbox headroom, so relay backpressure propagates to
+        the watermarked object queue and pauses connection reads)."""
+        forwards = self.role_spec.forwards_ingest
         while not self.shutdown.is_set():
             h, header, payload = await self.ctx.object_queue.get()
-            await self.processor.queue.put(payload)
+            if forwards:
+                await self.role_runtime.handoff(h, header, payload)
+            else:
+                await self.processor.queue.put(payload)
 
     async def stop(self) -> None:
         """Orderly shutdown (reference shutdown.py:19-91)."""
@@ -284,6 +331,10 @@ class Node:
         if self.udp is not None:
             await self.udp.stop()
         await self.pool.stop()
+        if self.role_runtime is not None:
+            # edge: flush the un-acked outbox to the relay (bounded);
+            # relay: stop serving IPC before the processor drains
+            await self.role_runtime.stop()
         await self.sender.stop()
         await self.processor.stop()
         await self.cleaner.stop()

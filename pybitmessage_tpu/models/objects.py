@@ -72,6 +72,19 @@ class ObjectHeader:
         return self.header_length
 
 
+def extract_tag(header: ObjectHeader, payload) -> bytes:
+    """The 32-byte inventory routing tag, for object kinds that carry
+    one: getpubkey/pubkey from v4, broadcast only from v5 (a v4
+    broadcast's first 32 bytes are ciphertext, not a tag).  Accepts
+    bytes or a memoryview; returns ``b""`` for untagged objects."""
+    tagged = (header.object_type in (0, 1) and header.version >= 4) or \
+             (header.object_type == 3 and header.version >= 5)
+    if tagged and len(payload) >= header.header_length + 32:
+        return bytes(
+            payload[header.header_length:header.header_length + 32])
+    return b""
+
+
 def serialize_object(expires: int, object_type: int, version: int,
                      stream: int, body: bytes, nonce: int = 0) -> bytes:
     """Assemble a full object payload.  ``nonce=0`` leaves a placeholder

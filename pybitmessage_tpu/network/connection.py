@@ -22,7 +22,8 @@ from ..models.constants import (
     MAGIC, MAX_MESSAGE_SIZE, MAX_OBJECT_COUNT, MAX_TIME_OFFSET,
     NODE_DANDELION, NODE_SSL, NODE_SYNC, NODE_TRACE, PROTOCOL_VERSION,
 )
-from ..models.objects import ObjectError, ObjectHeader, check_by_type
+from ..models.objects import (ObjectError, ObjectHeader, check_by_type,
+                              extract_tag)
 from ..models.packet import (
     HEADER_LEN, PacketError, pack_packet, unpack_header, verify_payload,
 )
@@ -524,6 +525,14 @@ class BMConnection:
             try:
                 item = self.ctx.inventory[h]
             except KeyError:
+                # edge role (docs/roles.md): a hash we KNOW exists
+                # relay-side but don't hold locally is fetched over
+                # role IPC and re-served when the payload lands — not
+                # treated as unknown (no intersection-probe penalty
+                # for objects the shard genuinely has)
+                fetcher = getattr(self.ctx, "payload_fetcher", None)
+                if fetcher is not None and fetcher(h, self):
+                    continue
                 self._anti_intersection_delay()
                 continue
             await self.send_object(h, item.payload)
@@ -693,13 +702,7 @@ class BMConnection:
         if not isinstance(payload, (bytes, bytearray)):
             COPIED_MATERIALIZE.inc(len(payload))
             payload = bytes(payload)
-        # getpubkey/pubkey carry a tag from v4; broadcast only from v5
-        # (a v4 broadcast's first 32 bytes are ciphertext, not a tag)
-        tagged = (header.object_type in (0, 1) and header.version >= 4) or \
-                 (header.object_type == 3 and header.version >= 5)
-        tag = b""
-        if tagged and len(payload) >= header.header_length + 32:
-            tag = payload[header.header_length:header.header_length + 32]
+        tag = extract_tag(header, payload)
         self.ctx.inventory.add(
             h, header.object_type, header.stream, payload, header.expires,
             tag)

@@ -128,6 +128,10 @@ class NodeContext:
         #: SOCKS proxy for outbound dials (Tor support): None or a dict
         #: {type: "SOCKS5"|"SOCKS4a", host, port, username, password}
         self.proxy: dict | None = None
+        #: edge role (docs/roles.md): async payload fetch for getdata
+        #: hashes known relay-side but not cached locally — a callable
+        #: ``(hash, conn) -> bool`` or None
+        self.payload_fetcher = None
 
     def enable_tls(self, directory=None) -> None:
         # graceful degradation on minimal images: the ephemeral cert
@@ -174,6 +178,12 @@ class ConnectionPool:
         self._server: asyncio.AbstractServer | None = None
         self._tasks: list[asyncio.Task] = []
         self.on_object: Callable | None = None  # hook for the processor
+        #: relay role hook: called by announce_object for locally-
+        #: originated objects so edges receive the full payload
+        self.on_announce: Callable | None = None
+        #: share the listen socket across processes (edge role: N edge
+        #: processes accept on one port, kernel-balanced)
+        self.reuse_port = False
         #: set-reconciliation subsystem (docs/sync.md); None keeps the
         #: classic flooding-only paths
         self.reconciler = None
@@ -190,8 +200,28 @@ class ConnectionPool:
     def connections(self) -> list[BMConnection]:
         return list(self.outbound) + list(self.inbound)
 
-    def established(self) -> list[BMConnection]:
-        return [c for c in self.connections() if c.fully_established]
+    @staticmethod
+    def _subscribes(conn, stream: int) -> bool:
+        """Per-stream overlay membership: a connection hears stream k
+        when its negotiated streams include k.  Connections that never
+        advertised streams (test doubles, pre-handshake) always
+        subscribe."""
+        streams = getattr(conn, "streams", None)
+        return not streams or stream in streams
+
+    def established(self, stream: int | None = None) -> list[BMConnection]:
+        """Fully-established connections, optionally only those whose
+        negotiated streams overlay ``stream`` (docs/roles.md: the
+        per-stream overlay — announcements for stream k only reach
+        peers subscribed to k)."""
+        conns = [c for c in self.connections() if c.fully_established]
+        if stream is None:
+            return conns
+        return [c for c in conns if self._subscribes(c, stream)]
+
+    def stream_overlay(self) -> dict[int, int]:
+        """Established-peer count per subscribed stream (roleStatus)."""
+        return {s: len(self.established(s)) for s in self.ctx.streams}
 
     def _used_groups(self) -> set[bytes]:
         return {network_group(c.host) for c in self.outbound}
@@ -203,7 +233,8 @@ class ConnectionPool:
         CONNECTIONS.labels(direction="outbound").set(len(self.outbound))
         if listen:
             self._server = await asyncio.start_server(
-                self._accept, self.listen_host, self.ctx.port)
+                self._accept, self.listen_host, self.ctx.port,
+                reuse_port=True if self.reuse_port else None)
         self._tasks = [
             asyncio.create_task(self._dial_loop()),
             asyncio.create_task(self._inv_loop()),
@@ -347,17 +378,26 @@ class ConnectionPool:
             Peer(entry.host, entry.port), entry.stream,
             lastseen=min(int(entry.time), int(time.time())))
 
-    def _route_announcement(self, h: bytes, conns) -> None:
+    def _route_announcement(self, h: bytes, conns,
+                            stream: int | None = None) -> None:
         """Fan one announcement out: stem-phase hashes always ride the
         classic trackers (dandelion routing decides who may see them —
         they must NEVER enter a reconciliation sketch), everything
         else goes through the reconciler's flood/pending split when
-        sync is enabled."""
+        sync is enabled.  With a known ``stream`` the fan-out honors
+        the per-stream overlay: only peers subscribed to that stream
+        hear it, and a stream outside this process's shard
+        (``ctx.streams``) is never announced at all — the shard
+        boundary (docs/roles.md, docs/sync.md)."""
+        if stream is not None:
+            if stream not in self.ctx.streams:
+                return
+            conns = [c for c in conns if self._subscribes(c, stream)]
         LIFECYCLE.record(h, "announced")
         dand = self.ctx.dandelion
         if self.reconciler is not None and \
                 (dand is None or not dand.in_stem_phase(h)):
-            self.reconciler.route_announcement(h, conns)
+            self.reconciler.route_announcement(h, conns, stream=stream)
             return
         for conn in conns:
             conn.tracker.we_should_announce(h)
@@ -370,7 +410,8 @@ class ConnectionPool:
         OBJECTS_RECEIVED.inc()
         LIFECYCLE.record(h, "received")
         self._route_announcement(
-            h, [c for c in self.established() if c is not source])
+            h, [c for c in self.established() if c is not source],
+            stream=getattr(header, "stream", None))
         self.ctx.object_queue.put_nowait((h, header, payload))
         if self.on_object is not None:
             self.on_object(h, header, payload, source)
@@ -383,7 +424,9 @@ class ConnectionPool:
         if local and dand and dand.enabled and \
                 random.randrange(100) < dand.stem_probability:
             dand.add_hash(h, stream, source=None)
-        self._route_announcement(h, self.established())
+        self._route_announcement(h, self.established(), stream=stream)
+        if self.on_announce is not None:
+            self.on_announce(h, stream, local)
 
     # -- periodic tasks ------------------------------------------------------
 
@@ -495,7 +538,8 @@ class ConnectionPool:
             for h, stream in dand.expire_fluffed():
                 # stem timer expired: the hash is now an ordinary
                 # fluff announcement and may use the sync paths
-                self._route_announcement(h, self.established())
+                self._route_announcement(h, self.established(),
+                                         stream=stream)
         if self.reconciler is not None:
             await self.reconciler.tick()
         for conn in self.established():

@@ -169,6 +169,28 @@ class HealthMonitor:
         if node is None:
             return out
 
+        # role identity + IPC hand-off health (docs/roles.md): rides
+        # every federation push, so federatedStatus renders per-ROLE
+        # verdicts for a split deployment
+        runtime = getattr(node, "role_runtime", None)
+        ipc_ok, ipc_detail = True, {}
+        if runtime is not None:
+            snap = runtime.snapshot()
+            links = snap.get("links")
+            if links is not None:      # edge: link/breaker state
+                ipc_ok = all(lk["connected"] and not lk["breakerOpen"]
+                             for lk in links)
+                ipc_detail = {"links": len(links),
+                              "outbox": sum(lk["outbox"] + lk["unacked"]
+                                            for lk in links)}
+            else:                      # relay: connected edge count
+                ipc_detail = {"edges": len(snap.get("edges", ()))}
+        out["role"] = _verdict(
+            ipc_ok, name=getattr(node, "role", "all"),
+            streams=list(getattr(getattr(node, "ctx", None),
+                                 "streams", ())),
+            **ipc_detail)
+
         # pow: queue depth + any open breaker
         from ..resilience.policy import BREAKERS
         open_breakers = [n for n, b in BREAKERS.items()

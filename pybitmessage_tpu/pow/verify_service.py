@@ -44,12 +44,35 @@ VERIFY_SHUTDOWN = REGISTRY.counter(
     "verification tasks")
 
 
+def _accelerator_backend() -> bool:
+    """True when the default JAX backend is a real accelerator.  On a
+    CPU backend the XLA 'device' batch pays ~100 ms of dispatch per
+    drain while two host SHA-512s cost ~2 µs — routing batches to the
+    device there CAPPED the whole ingest path at ~25 obj/s (measured,
+    ISSUE 14).  Mirrors the ``cryptotpu=auto`` probe semantics."""
+    try:
+        import jax
+        return jax.default_backend() != "cpu"
+    except Exception:  # pragma: no cover — jax absent/broken
+        from ..resilience.policy import ERRORS
+        ERRORS.labels(site="pow.verify_probe").inc()
+        logger.info("JAX backend probe failed; PoW verification stays "
+                    "on the host path", exc_info=True)
+        return False
+
+
 class BatchVerifier:
-    """Coalesces ``check(object_bytes)`` calls into device batches."""
+    """Coalesces ``check(object_bytes)`` calls into device batches.
+
+    ``use_device``: ``"auto"`` (default) uses the device only on a
+    real accelerator backend — host hashlib wins on CPU; ``True``
+    forces the device path (kernel-plumbing tests, hardware runs);
+    ``False`` disables it."""
 
     def __init__(self, *, ntpb: int = 0, extra: int = 0,
                  clamp: bool = True, window: float = 0.0,
-                 min_device_batch: int = 4, use_device: bool = True):
+                 min_device_batch: int = 4,
+                 use_device: "bool | str" = "auto"):
         # Normalize 0 -> network defaults so the device path
         # (pow_target) and the host path (check_pow, which substitutes
         # defaults itself) agree — and never divide by zero.
@@ -59,6 +82,7 @@ class BatchVerifier:
         self.window = window
         self.min_device_batch = min_device_batch
         self.use_device = use_device
+        self._device_ok: bool | None = None   # lazy auto probe
         self.queue: asyncio.Queue = asyncio.Queue()
         self._task: asyncio.Task | None = None
         #: observability: how many objects went down each path
@@ -67,6 +91,17 @@ class BatchVerifier:
         self.device_batches = 0
 
     def start(self) -> asyncio.Task:
+        if self.use_device == "auto" and self._device_ok is None:
+            # resolve the backend probe OFF the event loop: the first
+            # jax.default_backend() call initializes the backend
+            # (hundreds of ms) and must not freeze mid-ingest.  Until
+            # it lands, batches take the host path (always correct).
+            import threading
+
+            def probe() -> None:
+                self._device_ok = _accelerator_backend()
+            threading.Thread(target=probe, daemon=True,
+                             name="pow-verify-probe").start()
         self._task = asyncio.create_task(self._run())
         return self._task
 
@@ -122,7 +157,7 @@ class BatchVerifier:
                     batch.append(self.queue.get_nowait())
                 results = None
                 VERIFY_BATCH_SIZE.observe(len(batch))
-                if self.use_device and \
+                if self._want_device() and \
                         len(batch) >= self.min_device_batch:
                     try:
                         results = await self._device_verify(
@@ -156,6 +191,12 @@ class BatchVerifier:
                 for _, fut in batch:
                     self._settle_unverified(fut)
                 raise
+
+    def _want_device(self) -> bool:
+        if self.use_device == "auto":
+            # None = probe still pending -> host path (never blocks)
+            return bool(self._device_ok)
+        return bool(self.use_device)
 
     async def _device_verify(self, objects: list[bytes]) -> list[bool]:
         from ..ops.pow_search import verify

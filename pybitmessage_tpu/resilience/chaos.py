@@ -21,6 +21,9 @@ Site catalog (docs/resilience.md keeps the authoritative table):
 ``farm.accept``        a farm job submission accept (``powfarm/server.py``)
 ``farm.dispatch``      a farm batch launch through the solver ladder
 ``farm.result``        a farm result frame send back to a client
+``role.ipc``           a cross-role IPC frame send — the edge->relay
+                       object hand-off and the relay's ack/push sends
+                       (``roles/edge.py``, ``roles/relay.py``)
 ==================  =====================================================
 
 Arming, one of:
@@ -60,6 +63,7 @@ class ChaosError(RuntimeError):
 _DEFAULT_EXC: dict[str, type] = {
     "net.dial": OSError,
     "net.send": ConnectionError,
+    "role.ipc": ConnectionError,
 }
 
 

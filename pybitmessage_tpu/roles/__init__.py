@@ -1,0 +1,29 @@
+"""Composable node roles (docs/roles.md).
+
+The monolithic node refactored into roles — ``edge`` (sockets +
+framing + PoW verify), ``relay`` (storage + sync + processing) and
+the ``powfarm`` solver (its own package) — runnable fused in one
+process (``all``, the default) or as separate processes sharded by
+stream behind one API, connected by the length-prefixed role IPC
+channel in :mod:`pybitmessage_tpu.roles.ipc`.
+"""
+
+from .registry import ROLES, RoleSpec, get_role, parse_role_streams
+from .streams import shard_owner, stream_for_address, stream_for_ripe
+
+__all__ = [
+    "ROLES", "RoleSpec", "get_role", "parse_role_streams",
+    "shard_owner", "stream_for_address", "stream_for_ripe",
+    "EdgeCache", "EdgeRuntime", "RelayRuntime",
+]
+
+
+def __getattr__(name):  # PEP 562: runtime classes import lazily so the
+    # registry/mapper stay importable on dependency-free images
+    if name in ("EdgeCache", "EdgeRuntime"):
+        from . import edge
+        return getattr(edge, name)
+    if name == "RelayRuntime":
+        from .relay import RelayRuntime
+        return RelayRuntime
+    raise AttributeError(name)

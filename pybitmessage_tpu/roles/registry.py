@@ -1,0 +1,84 @@
+"""Role registry: which subsystems each node role composes.
+
+The monolithic node is now a *composition* of roles (docs/roles.md).
+``RoleSpec`` declares what a role runs; ``core/node.py`` consults the
+spec at construction time so one codebase serves every deployment
+shape behind one API:
+
+==========  ============================================================
+``all``     the fused single-process node — every subsystem, today's
+            default; every pre-existing test and deployment runs
+            unchanged
+``edge``    listener sockets (``SO_REUSEPORT``-shared), zero-copy
+            framing, device-batched PoW verification, dedupe cache —
+            accepted objects are handed to their stream's relay over
+            the role IPC channel.  No storage authority, no sync, no
+            message processing (identity keys live with the relay).
+``relay``   inventory authority for its stream shard: slab/sql store,
+            set-reconciliation sync, announcement routing, the object
+            processor + sender and the federation aggregator.  Serves
+            the role IPC channel; does not open the shared P2P
+            listener (edges own the port).
+==========  ============================================================
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+@dataclass(frozen=True)
+class RoleSpec:
+    """What one role runs (consulted by ``Node.__init__``)."""
+
+    name: str
+    #: opens the shared P2P listener (edges parallelize accept/framing
+    #: across processes via SO_REUSEPORT)
+    listens_p2p: bool = True
+    #: owns an authoritative object store (relay/all); edges keep a
+    #: bounded dedupe/serve cache instead
+    owns_storage: bool = True
+    #: runs the set-reconciliation sync subsystem (shard boundary)
+    runs_sync: bool = True
+    #: runs the ObjectProcessor/Sender pipeline (needs identity keys)
+    processes_objects: bool = True
+    #: forwards accepted objects over role IPC instead of processing
+    forwards_ingest: bool = False
+    #: serves the role IPC channel for edge hand-offs
+    serves_ipc: bool = False
+    #: shares the P2P listen socket across processes
+    reuse_port: bool = False
+    extras: dict = field(default_factory=dict)
+
+
+ROLES: dict[str, RoleSpec] = {
+    "all": RoleSpec("all"),
+    "edge": RoleSpec("edge", owns_storage=False, runs_sync=False,
+                     processes_objects=False, forwards_ingest=True,
+                     reuse_port=True),
+    "relay": RoleSpec("relay", listens_p2p=False, serves_ipc=True),
+}
+
+
+def get_role(name: str) -> RoleSpec:
+    try:
+        return ROLES[name]
+    except KeyError:
+        raise ValueError("unknown node role %r (one of %s)"
+                         % (name, "/".join(sorted(ROLES))))
+
+
+def parse_role_streams(spec: str) -> tuple[int, ...]:
+    """Parse the ``rolestreams`` knob: a comma list of stream numbers
+    -> sorted unique tuple.  Empty spec -> empty tuple (caller falls
+    back to the default stream).  Raises ``ValueError`` on junk."""
+    out = set()
+    for entry in str(spec or "").split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        value = int(entry)          # ValueError on junk
+        if not 1 <= value <= 2 ** 32 - 1:
+            raise ValueError("stream %d out of range" % value)
+        out.add(value)
+    return tuple(sorted(out))

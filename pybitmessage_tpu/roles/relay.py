@@ -1,0 +1,333 @@
+"""The relay role: stream-shard inventory authority behind role IPC.
+
+A relay owns storage, sync, announcement routing and the object
+processor for its shard of streams (``rolestreams``), and serves the
+role IPC channel edges hand objects over (docs/roles.md).  It does
+not open the shared P2P listener — edges own the port; the relay is
+the fleet's memory and brain, the edges its mouth and ears.
+
+Ingest is idempotent by inventory hash, so the edge's at-least-once
+redelivery after a crash or a ``role.ipc`` fault nets exactly-once
+acceptance.  Everything a relay accepts — over IPC, from its own
+outbound P2P peers, or from its local sender — flows back out as
+INV deltas (hash-level, for dedupe + announce) and OBJECT_PUSHes
+(full payloads for relay-originated objects and getdata fetches).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+
+from ..observability import REGISTRY
+from ..resilience import inject
+from ..resilience.policy import ERRORS
+from . import ipc
+
+logger = logging.getLogger("pybitmessage_tpu.roles")
+
+RELAY_OBJECTS = REGISTRY.counter(
+    "role_relay_objects_total",
+    "Objects ingested over role IPC, by outcome", ("result",))
+RELAY_EDGES = REGISTRY.gauge(
+    "role_relay_edges", "Edge processes connected over role IPC")
+RELAY_PUSHES = REGISTRY.counter(
+    "role_relay_push_total",
+    "Relay->edge pushes by kind (inv delta / full object)", ("kind",))
+
+#: INV delta flush cadence, seconds
+INV_FLUSH_INTERVAL = 0.05
+
+
+class _RecordHeader:
+    """Header-shaped view of an IPC object record — what the pool's
+    per-stream announcement routing and the processor pump need."""
+
+    __slots__ = ("object_type", "stream", "expires", "version",
+                 "header_length")
+
+    def __init__(self, object_type: int, stream: int, expires: int):
+        self.object_type = object_type
+        self.stream = stream
+        self.expires = expires
+        self.version = 0
+        self.header_length = 0
+
+
+class _EdgeConn:
+    """One connected edge process (relay side)."""
+
+    def __init__(self, writer: asyncio.StreamWriter):
+        self.writer = writer
+        self.edge_id = ""
+        self.edge_streams: tuple[int, ...] = ()
+        self.lock = asyncio.Lock()
+        #: accumulated INV delta entries awaiting the next flush
+        self.pending_inv: list[tuple[int, int, bytes]] = []
+        self.objects_received = 0
+
+    #: per-send drain ceiling — a blackholed edge must fail fast and
+    #: reconnect, not wedge the relay's fan-out paths for TCP-timeout
+    #: minutes
+    SEND_TIMEOUT = 10.0
+
+    async def send(self, frame: bytes) -> None:
+        async with self.lock:
+            inject("role.ipc")
+            self.writer.write(frame)
+            try:
+                await asyncio.wait_for(self.writer.drain(),
+                                       self.SEND_TIMEOUT)
+            except asyncio.TimeoutError:
+                self.writer.close()
+                raise ConnectionError("edge %s wedged mid-send"
+                                      % self.edge_id[:8])
+
+
+class RelayRuntime:
+    """Serves the role IPC channel and wires relay-side hooks."""
+
+    def __init__(self, node, listen: str):
+        self.node = node
+        host, _, port = str(listen).rpartition(":")
+        self.host = host or "127.0.0.1"
+        self.port = int(port)
+        self.edges: list[_EdgeConn] = []
+        self._server: asyncio.AbstractServer | None = None
+        self._flush_task: asyncio.Task | None = None
+        self.objects_accepted = 0
+        self.objects_duplicate = 0
+        self.objects_rejected = 0
+        self._chain_on_object = None
+
+    # -- lifecycle -----------------------------------------------------------
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(
+            self._serve, self.host, self.port)
+        pool = self.node.pool
+        self._chain_on_object = pool.on_object
+        pool.on_object = self._on_object
+        pool.on_announce = self._on_announce
+        self._flush_task = asyncio.create_task(self._inv_flush_loop())
+
+    @property
+    def listen_port(self) -> int:
+        if self._server and self._server.sockets:
+            return self._server.sockets[0].getsockname()[1]
+        return self.port
+
+    async def stop(self) -> None:
+        if self._flush_task is not None:
+            self._flush_task.cancel()
+            try:
+                await self._flush_task
+            except asyncio.CancelledError:
+                pass
+        await self._flush_inv()
+        if self._server is not None:
+            self._server.close()
+        for edge in list(self.edges):
+            try:
+                edge.writer.close()
+            except Exception as exc:
+                ERRORS.labels(site="role.ipc").inc()
+                logger.debug("edge close failed: %r", exc)
+        if self._server is not None:
+            await self._server.wait_closed()
+
+    # -- IPC serving ---------------------------------------------------------
+
+    async def _serve(self, reader: asyncio.StreamReader,
+                     writer: asyncio.StreamWriter) -> None:
+        edge = _EdgeConn(writer)
+        try:
+            msg_type, payload = await asyncio.wait_for(
+                ipc.read_frame(reader), 10.0)
+            if msg_type != ipc.MSG_HELLO:
+                raise ipc.IPCError("expected HELLO, got %d" % msg_type)
+            role, edge.edge_id, edge.edge_streams = \
+                ipc.decode_hello(payload)
+            await edge.send(ipc.pack_frame(
+                ipc.MSG_HELLO_ACK, ipc.encode_hello(
+                    "relay", self.node.node_id,
+                    tuple(self.node.ctx.streams))))
+            self.edges.append(edge)
+            RELAY_EDGES.set(len(self.edges))
+            logger.info("edge %s connected (streams %s)",
+                        edge.edge_id[:8], edge.edge_streams or "(all)")
+            while True:
+                msg_type, payload = await ipc.read_frame(reader)
+                if msg_type == ipc.MSG_OBJECTS:
+                    await self._handle_objects(edge, payload)
+                elif msg_type == ipc.MSG_FETCH:
+                    await self._handle_fetch(edge, payload)
+                elif msg_type == ipc.MSG_PING:
+                    await edge.send(ipc.pack_frame(ipc.MSG_PONG, b""))
+                elif msg_type == ipc.MSG_PONG:
+                    pass
+                else:
+                    logger.debug("unexpected role-ipc frame %d from "
+                                 "edge %s", msg_type, edge.edge_id[:8])
+        except asyncio.CancelledError:
+            raise
+        except (OSError, ConnectionError, asyncio.IncompleteReadError,
+                asyncio.TimeoutError, ipc.IPCError) as exc:
+            ERRORS.labels(site="role.ipc").inc()
+            logger.debug("edge connection closed: %r", exc)
+        except Exception:
+            ERRORS.labels(site="role.ipc").inc()
+            logger.exception("edge connection failed")
+        finally:
+            if edge in self.edges:
+                self.edges.remove(edge)
+                RELAY_EDGES.set(len(self.edges))
+            try:
+                writer.close()
+                await asyncio.wait_for(writer.wait_closed(), 2.0)
+            except Exception as exc:
+                ERRORS.labels(site="role.ipc").inc()
+                logger.debug("edge transport close failed: %r", exc)
+
+    async def _handle_objects(self, edge: _EdgeConn,
+                              payload: bytes) -> None:
+        # ingest backpressure: while the processor queue sits above its
+        # watermark, stop consuming frames — the edge's outbox fills,
+        # its pump pauses, its connection reads pause, TCP pushes back
+        wait_resume = getattr(self.node.ctx.object_queue,
+                              "wait_resume", None)
+        if wait_resume is not None:
+            await wait_resume()
+        seq, records = ipc.decode_objects(payload)
+        accepted = duplicate = rejected = 0
+        for record in records:
+            result = self._accept_record(record, edge)
+            if result == "accepted":
+                accepted += 1
+            elif result == "duplicate":
+                duplicate += 1
+            else:
+                rejected += 1
+            RELAY_OBJECTS.labels(result=result).inc()
+        edge.objects_received += len(records)
+        self.objects_accepted += accepted
+        self.objects_duplicate += duplicate
+        self.objects_rejected += rejected
+        # INV deltas ride the periodic flusher, NOT this path: one
+        # wedged sibling edge must never head-of-line-block another
+        # edge's ingest ack
+        await edge.send(ipc.pack_frame(
+            ipc.MSG_OBJECTS_ACK,
+            ipc.encode_objects_ack(seq, accepted, duplicate, rejected)))
+
+    def _accept_record(self, record, edge: _EdgeConn) -> str:
+        h, type_, stream, expires, tag, payload = record
+        ctx = self.node.ctx
+        if stream not in ctx.streams:
+            # shard boundary: this relay does not own the stream — the
+            # edge mis-routed (stale routing table).  Refuse rather
+            # than pollute the shard's digest/sketches.
+            return "rejected"
+        if h in ctx.inventory:
+            return "duplicate"
+        ctx.inventory.add(h, type_, stream, payload, expires, tag)
+        self.node.pool.object_received(
+            h, _RecordHeader(type_, stream, expires), payload,
+            source=edge)
+        return "accepted"
+
+    async def _handle_fetch(self, edge: _EdgeConn,
+                            payload: bytes) -> None:
+        h = ipc.decode_fetch(payload)
+        try:
+            item = self.node.ctx.inventory[h]
+        except KeyError:
+            logger.debug("fetch for unknown hash %s", h.hex()[:16])
+            return
+        RELAY_PUSHES.labels(kind="object").inc()
+        await edge.send(ipc.pack_frame(
+            ipc.MSG_OBJECT_PUSH, ipc.encode_record(
+                h, item.type, item.stream, item.expires, item.tag,
+                item.payload)))
+
+    # -- relay -> edge fan-out ----------------------------------------------
+
+    def _on_object(self, h: bytes, header, payload, source) -> None:
+        """Every accepted object (IPC, P2P, local) becomes an INV
+        delta to every edge except the one that delivered it."""
+        entry = (header.stream, header.expires, h)
+        for edge in self.edges:
+            if edge is not source:
+                edge.pending_inv.append(entry)
+        if self._chain_on_object is not None:
+            self._chain_on_object(h, header, payload, source)
+
+    def _on_announce(self, h: bytes, stream: int, local: bool) -> None:
+        """A locally-originated announcement (sender/API): edges need
+        the PAYLOAD, not just the hash — they serve the getdata."""
+        if not local or not self.edges:
+            return
+        try:
+            item = self.node.ctx.inventory[h]
+        except KeyError:
+            return
+        frame = ipc.pack_frame(
+            ipc.MSG_OBJECT_PUSH, ipc.encode_record(
+                h, item.type, item.stream, item.expires, item.tag,
+                item.payload))
+        for edge in list(self.edges):
+            RELAY_PUSHES.labels(kind="object").inc()
+            task = asyncio.ensure_future(edge.send(frame))
+            task.add_done_callback(_log_send_error)
+
+    async def _inv_flush_loop(self) -> None:
+        while True:
+            await asyncio.sleep(INV_FLUSH_INTERVAL)
+            try:
+                await self._flush_inv()
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                ERRORS.labels(site="role.ipc").inc()
+                logger.exception("inv delta flush failed")
+
+    async def _flush_inv(self) -> None:
+        for edge in list(self.edges):
+            if not edge.pending_inv:
+                continue
+            entries, edge.pending_inv = edge.pending_inv, []
+            RELAY_PUSHES.labels(kind="inv").inc()
+            try:
+                await edge.send(ipc.pack_frame(
+                    ipc.MSG_INV, ipc.encode_inv(entries)))
+            except (OSError, ConnectionError) as exc:
+                # a dead edge's INV delta is harmless to drop — the
+                # edge re-learns on reconnect HELLO + future deltas;
+                # count it so the loss is visible
+                ERRORS.labels(site="role.ipc").inc()
+                logger.debug("inv delta to edge %s failed: %r",
+                             edge.edge_id[:8], exc)
+
+    # -- observability -------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        return {
+            "listen": "%s:%d" % (self.host, self.listen_port),
+            "edges": [{
+                "edgeId": e.edge_id,
+                "streams": list(e.edge_streams),
+                "objectsReceived": e.objects_received,
+            } for e in self.edges],
+            "accepted": self.objects_accepted,
+            "duplicates": self.objects_duplicate,
+            "rejected": self.objects_rejected,
+        }
+
+
+def _log_send_error(task: asyncio.Task) -> None:
+    if task.cancelled():
+        return
+    exc = task.exception()
+    if exc is not None:
+        ERRORS.labels(site="role.ipc").inc()
+        logger.debug("object push failed: %r", exc)

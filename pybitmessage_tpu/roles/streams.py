@@ -1,0 +1,50 @@
+"""Dynamic stream assignment (docs/roles.md, ROADMAP item 4).
+
+Streams are the protocol's built-in horizontal-scale primitive; the
+reference left every object in stream 1.  This module supplies the
+deterministic address->stream mapper that spreads *new* identities
+across a configured stream count, so capacity scales by adding stream
+shards (relays) instead of growing one node.
+
+The mapper must be a pure function of the address material — every
+node, edge and client derives the same stream for the same address
+with no coordination — and stable forever once deployed (a re-mapped
+address would strand its mail on the old shard).  It hashes the
+address ripe, NOT the encoded address string, so every encoding of an
+identity maps identically.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import struct
+
+
+def stream_for_ripe(ripe: bytes, nstreams: int = 1) -> int:
+    """Deterministic stream for an address ripe: 1-based, uniform over
+    ``nstreams`` via the first 8 bytes of sha512(ripe)."""
+    if nstreams <= 1:
+        return 1
+    digest = hashlib.sha512(ripe).digest()
+    (word,) = struct.unpack_from(">Q", digest, 0)
+    return 1 + word % nstreams
+
+
+def stream_for_address(address: str, nstreams: int = 1) -> int:
+    """Deterministic stream for an encoded ``BM-`` address."""
+    from ..utils.addresses import decode_address
+    return stream_for_ripe(decode_address(address).ripe, nstreams)
+
+
+def shard_owner(stream: int, shards: dict) -> object | None:
+    """Pick the owner of ``stream`` from a ``{owner: streams}`` table
+    (an edge's relay-link routing table, built from HELLO_ACKs).
+    Falls back to an owner with an empty stream set (a catch-all
+    relay), then None."""
+    catch_all = None
+    for owner, streams in shards.items():
+        if stream in streams:
+            return owner
+        if not streams:
+            catch_all = owner
+    return catch_all

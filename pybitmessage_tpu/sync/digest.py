@@ -40,10 +40,22 @@ def bucket_of(hash_: bytes, buckets: int = DIGEST_BUCKETS) -> int:
 
 
 class InventoryDigest:
-    """Incremental per-stream bucket summaries over unexpired hashes."""
+    """Incremental per-stream bucket summaries over unexpired hashes.
 
-    def __init__(self, buckets: int = DIGEST_BUCKETS):
+    ``streams`` optionally restricts the digest to a subscribed shard
+    (docs/roles.md): a stream-sharded relay's digest must only ever
+    summarize its own streams, even if an out-of-shard object leaks
+    into the backing store — the digest is the shard boundary the
+    catch-up/reconciliation machinery reads, so the restriction here
+    guarantees no cross-shard hash can enter a sketch or an inv list
+    (regression-guarded in tests/test_roles.py).  ``None`` (default)
+    keeps the historical fold-everything behavior for fused nodes.
+    """
+
+    def __init__(self, buckets: int = DIGEST_BUCKETS,
+                 streams: "set[int] | None" = None):
         self.buckets = buckets
+        self.streams = set(streams) if streams is not None else None
         self._lock = threading.RLock()
         #: hash -> (stream, expires, short_id) — exact removal support
         self._entries: dict[bytes, tuple[int, int, int]] = {}
@@ -62,6 +74,8 @@ class InventoryDigest:
     # -- incremental maintenance (storage/inventory.py hooks) ----------------
 
     def add(self, hash_: bytes, stream: int, expires: int) -> None:
+        if self.streams is not None and stream not in self.streams:
+            return  # out-of-shard: never folded, never announced
         with self._lock:
             if hash_ in self._entries:
                 return

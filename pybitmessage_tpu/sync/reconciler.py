@@ -255,10 +255,20 @@ class Reconciler:
 
     # -- announcement routing -------------------------------------------------
 
-    def route_announcement(self, h: bytes, conns) -> None:
+    def route_announcement(self, h: bytes, conns,
+                           stream: int | None = None) -> None:
         """Route one new-object announcement: flood a sqrt(n) subset of
         reconciling peers (plus every legacy/broken-breaker peer),
-        queue the rest into pending sets."""
+        queue the rest into pending sets.
+
+        Shard boundary (docs/roles.md): when the caller knows the
+        object's stream and it is outside this node's subscribed
+        shard, the hash must never enter a pending set — pending sets
+        feed sketches, and a sketch must only ever summarize the
+        shard's own streams (regression-guarded in tests/test_roles.py).
+        """
+        if stream is not None and stream not in self.pool.ctx.streams:
+            return
         now = self.clock()
         self._note_recent(h)
         recon = []

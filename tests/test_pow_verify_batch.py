@@ -30,8 +30,12 @@ def _make_object(seed: bytes, ttl: int = 600) -> bytes:
 
 @pytest.mark.asyncio
 async def test_batch_verifier_device_path():
+    # use_device=True forces the device path on the CPU mesh —
+    # this test proves the kernel plumbing, not the auto policy
+    # (auto keeps batches on host hashlib off-accelerator)
     v = BatchVerifier(ntpb=NTPB, extra=EXTRA, clamp=False,
-                      window=0.05, min_device_batch=2)
+                      window=0.05, min_device_batch=2,
+                      use_device=True)
     v.start()
     try:
         objs = [_make_object(b"obj %d" % i) for i in range(4)]
@@ -101,6 +105,10 @@ async def test_flood_sync_uses_device_batches():
         expires = int.from_bytes(payload[8:16], "big")
         node_a.inventory.add(inventory_hash(payload), 2, 1, payload,
                              expires)
+    # force the device rung: the auto default keeps verification
+    # on host hashlib on the CPU mesh (docs/ingest.md), but this
+    # test proves flood arrivals COALESCE into device batches
+    node_b.pow_verifier.use_device = True
     await node_a.start()
     await node_b.start()
     try:

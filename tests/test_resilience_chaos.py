@@ -450,3 +450,88 @@ async def test_no_object_loss_under_crypto_faults(sites):
     if tpu_armed:
         assert REGISTRY.sample("crypto_tpu_fallback_total") > before_tpu
     db.close()
+
+
+# ---------------------------------------------------------------------------
+# role.ipc faults: the edge->relay hand-off never loses accepted objects
+# ---------------------------------------------------------------------------
+
+
+async def test_no_object_loss_under_role_ipc_faults():
+    """100% seeded failure injection on the edge->relay hand-off
+    (ISSUE 14 satellite): every accepted object survives in the
+    edge's outbox and is redelivered once the site stops firing —
+    zero loss, visible in the resend counter; a relay KILLED and
+    RESTARTED mid-flood loses nothing either (at-least-once delivery
+    + hash-idempotent ingest)."""
+    import os
+    import sys
+    sys.path.insert(0, os.path.dirname(__file__))
+    from test_roles import build_msg_objects, make_edge, make_relay, \
+        wait_for
+
+    payloads = build_msg_objects(18)
+    relay = make_relay()
+    await relay.start()
+    ipc_port = relay.role_runtime.listen_port
+    edge = make_edge([ipc_port])
+    await edge.start()
+    try:
+        await wait_for(lambda: edge.role_runtime.links[0].connected,
+                       what="edge link")
+        link = edge.role_runtime.links[0]
+        link.breaker.cooldown = 0.2
+        link.reconnect_max = 0.3
+        before_resends = REGISTRY.sample("role_edge_resend_total") or 0
+        before_chaos = REGISTRY.sample("chaos_injected_total",
+                                       {"site": "role.ipc"}) or 0
+        CHAOS.seed(SEED)
+        # every hand-off frame send fails for the first 10 fires —
+        # including relay-side ack/hello sends (both hops share the
+        # site), so the link churns through several reconnects
+        CHAOS.arm("role.ipc", probability=1.0, count=10)
+        try:
+            # feed through the pool exactly as the framing loop would
+            from types import SimpleNamespace as _NS
+
+            from pybitmessage_tpu.models.objects import ObjectHeader
+            from pybitmessage_tpu.utils.hashes import inventory_hash
+            for p in payloads[:9]:
+                hdr = ObjectHeader.parse(p)
+                h = inventory_hash(p)
+                edge.inventory.add(h, hdr.object_type, hdr.stream, p,
+                                   hdr.expires, b"")
+                edge.pool.object_received(h, hdr, p, source=_NS())
+            await wait_for(
+                lambda: len(relay.inventory) == 9, timeout=30.0,
+                what="redelivery after chaos")
+        finally:
+            CHAOS.disarm()
+        assert REGISTRY.sample("chaos_injected_total",
+                               {"site": "role.ipc"}) > before_chaos
+        assert REGISTRY.sample("role_edge_resend_total") > \
+            before_resends, "faults never forced a resend"
+        assert relay.role_runtime.snapshot()["rejected"] == 0
+
+        # relay killed mid-flood: objects pool in the edge outbox and
+        # drain after a restart on the same port
+        await relay.stop()
+        for p in payloads[9:]:
+            hdr = ObjectHeader.parse(p)
+            h = inventory_hash(p)
+            edge.inventory.add(h, hdr.object_type, hdr.stream, p,
+                               hdr.expires, b"")
+            edge.pool.object_received(h, hdr, p, source=_NS())
+        await asyncio.sleep(0.5)
+        assert link.depth() > 0, "outbox should hold the stranded objects"
+        relay2 = make_relay()
+        relay2.role_runtime.port = ipc_port
+        await relay2.start()
+        try:
+            await wait_for(lambda: len(relay2.inventory) == 9,
+                           timeout=30.0, what="drain into restarted relay")
+            assert link.depth() == 0
+        finally:
+            await relay2.stop()
+    finally:
+        await edge.stop()

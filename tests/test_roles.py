@@ -1,0 +1,640 @@
+"""Role-split node (docs/roles.md): registry, IPC codec, stream
+mapper, edge cache, shard boundaries, and the in-process edge->relay
+end-to-end path over real TCP + role IPC.
+
+The multi-PROCESS variants live in tests/test_roles_smoke.py (real
+subprocesses, `make roles-smoke`) and bench.py role_split.
+"""
+
+import asyncio
+import os
+import time
+
+import pytest
+
+from pybitmessage_tpu.roles import (
+    ROLES, get_role, parse_role_streams, shard_owner, stream_for_ripe,
+)
+from pybitmessage_tpu.roles import ipc
+from pybitmessage_tpu.roles.edge import EdgeCache
+
+# ---------------------------------------------------------------------------
+# shared builders (also exercised by the chaos suite)
+# ---------------------------------------------------------------------------
+
+
+def build_msg_objects(n, *, ntpb=10, extra=10, ttl=1200, stream=1,
+                      recipient=None, keystore=None, solver=None):
+    """Build ``n`` distinct PoW-valid OBJECT_MSG payloads addressed to
+    ``recipient`` (an OwnIdentity) or to nobody (trial-decrypt-miss
+    traffic).  ``solver`` overrides the pure-python PoW search (the
+    smoke test solves at full consensus difficulty via the C++
+    tier)."""
+    from pybitmessage_tpu.crypto import encrypt, priv_to_pub, sign
+    from pybitmessage_tpu.crypto.keys import random_private_key
+    from pybitmessage_tpu.models import msgcoding
+    from pybitmessage_tpu.models.constants import OBJECT_MSG
+    from pybitmessage_tpu.models.payloads import (MsgPlaintext,
+                                                  get_bitfield,
+                                                  object_shell)
+    from pybitmessage_tpu.models.pow_math import pow_target
+    from pybitmessage_tpu.pow.dispatcher import python_solve
+    from pybitmessage_tpu.utils.hashes import sha512
+    from pybitmessage_tpu.workers.keystore import KeyStore
+
+    ks = keystore or KeyStore()
+    sender = ks.create_random("roles sender")
+    if recipient is None:
+        pub = priv_to_pub(random_private_key())
+        ripe = b"\x00" * 20
+    else:
+        pub, ripe = recipient.pub_encryption_key, recipient.ripe
+    expires = int(time.time()) + ttl
+    shell = object_shell(expires, OBJECT_MSG, 1, stream)
+    out = []
+    for i in range(n):
+        body = msgcoding.encode_message("roles %d" % i, "body %d" % i)
+        plain = MsgPlaintext(
+            sender_version=sender.version, sender_stream=stream,
+            bitfield=get_bitfield(False),
+            pub_signing_key=sender.pub_signing_key,
+            pub_encryption_key=sender.pub_encryption_key,
+            nonce_trials_per_byte=ntpb, extra_bytes=extra,
+            dest_ripe=ripe, encoding=2, message=body, ack_data=b"")
+        plain.signature = sign(shell + plain.encode_unsigned(),
+                               sender.priv_signing)
+        sans_nonce = shell + encrypt(plain.encode(), pub)
+        target = pow_target(len(sans_nonce) + 8, ttl, ntpb, extra,
+                            clamp=False)
+        nonce, _ = (solver or python_solve)(sha512(sans_nonce), target)
+        out.append(nonce.to_bytes(8, "big") + sans_nonce)
+    return out
+
+
+class WireClient:
+    """A minimal raw-socket Bitmessage peer: version/verack handshake,
+    then object frames in, packets out."""
+
+    def __init__(self):
+        self.reader = None
+        self.writer = None
+        self.inbox: asyncio.Queue = asyncio.Queue()
+        self._task = None
+
+    async def connect(self, port, *, streams=(1,)):
+        from pybitmessage_tpu.models.packet import pack_packet
+        from pybitmessage_tpu.network.messages import VersionPayload
+        self.reader, self.writer = await asyncio.open_connection(
+            "127.0.0.1", port)
+        self.writer.write(pack_packet("version", VersionPayload(
+            remote_port=port, my_port=0, nonce=os.urandom(8),
+            services=1, streams=tuple(streams)).encode()))
+        await self.writer.drain()
+        got_version = got_verack = False
+        while not (got_version and got_verack):
+            cmd, payload = await self._read_packet()
+            if cmd == "version":
+                got_version = True
+                self.writer.write(pack_packet("verack"))
+                await self.writer.drain()
+            elif cmd == "verack":
+                got_verack = True
+        self._task = asyncio.create_task(self._pump())
+        return self
+
+    async def _read_packet(self):
+        from pybitmessage_tpu.models.packet import HEADER_LEN, unpack_header
+        header = await self.reader.readexactly(HEADER_LEN)
+        command, length, _ = unpack_header(header)
+        payload = await self.reader.readexactly(length)
+        return command, payload
+
+    async def _pump(self):
+        try:
+            while True:
+                self.inbox.put_nowait(await self._read_packet())
+        except (asyncio.IncompleteReadError, ConnectionError, OSError):
+            pass
+
+    async def send_objects(self, payloads):
+        from pybitmessage_tpu.models.packet import pack_packet
+        for p in payloads:
+            self.writer.write(pack_packet("object", p))
+        await self.writer.drain()
+
+    async def send_packet(self, command, payload=b""):
+        from pybitmessage_tpu.models.packet import pack_packet
+        self.writer.write(pack_packet(command, payload))
+        await self.writer.drain()
+
+    async def expect(self, command, timeout=10.0):
+        deadline = time.monotonic() + timeout
+        while True:
+            remain = deadline - time.monotonic()
+            if remain <= 0:
+                raise AssertionError("never received %r" % command)
+            cmd, payload = await asyncio.wait_for(self.inbox.get(),
+                                                  remain)
+            if cmd == command:
+                return payload
+
+    async def close(self):
+        if self._task:
+            self._task.cancel()
+        if self.writer:
+            self.writer.close()
+
+
+def make_relay(streams=None, backend="slab"):
+    from pybitmessage_tpu.core.node import Node
+    return Node(None, port=0, listen=False, test_mode=True,
+                tls_enabled=False, role="relay",
+                role_ipc_listen="127.0.0.1:0",
+                role_streams=streams, inventory_backend=backend)
+
+
+def make_edge(ipc_ports, streams=None):
+    from pybitmessage_tpu.core.node import Node
+    connect = ",".join("127.0.0.1:%d" % p for p in ipc_ports)
+    return Node(None, port=0, listen=True, test_mode=True,
+                tls_enabled=False, role="edge",
+                role_ipc_connect=connect, role_streams=streams)
+
+
+async def wait_for(predicate, timeout=15.0, what="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return
+        await asyncio.sleep(0.03)
+    raise AssertionError("timed out waiting for %s" % what)
+
+
+# ---------------------------------------------------------------------------
+# registry + mapper
+# ---------------------------------------------------------------------------
+
+
+def test_role_registry():
+    assert set(ROLES) == {"all", "edge", "relay"}
+    fused = get_role("all")
+    assert fused.owns_storage and fused.runs_sync and fused.listens_p2p
+    assert not fused.forwards_ingest and not fused.serves_ipc
+    edge = get_role("edge")
+    assert edge.forwards_ingest and edge.reuse_port
+    assert not edge.owns_storage and not edge.runs_sync
+    relay = get_role("relay")
+    assert relay.serves_ipc and relay.owns_storage and relay.runs_sync
+    assert not relay.listens_p2p
+    with pytest.raises(ValueError):
+        get_role("solver9000")
+
+
+def test_parse_role_streams():
+    assert parse_role_streams("") == ()
+    assert parse_role_streams("1") == (1,)
+    assert parse_role_streams("3, 1,2,3") == (1, 2, 3)
+    with pytest.raises(ValueError):
+        parse_role_streams("1,banana")
+    with pytest.raises(ValueError):
+        parse_role_streams("0")
+
+
+def test_stream_mapper_deterministic_and_uniform():
+    ripe = b"\x17" * 20
+    # stability golden: the mapping is a wire-compatibility contract —
+    # if this changes, deployed shards strand their addresses
+    assert stream_for_ripe(ripe, 1) == 1
+    assert stream_for_ripe(ripe, 8) == stream_for_ripe(ripe, 8)
+    import hashlib
+    import struct
+    (word,) = struct.unpack_from(">Q", hashlib.sha512(ripe).digest(), 0)
+    assert stream_for_ripe(ripe, 8) == 1 + word % 8
+    # rough uniformity over 4 streams
+    counts = {}
+    for i in range(4000):
+        s = stream_for_ripe(i.to_bytes(20, "big"), 4)
+        assert 1 <= s <= 4
+        counts[s] = counts.get(s, 0) + 1
+    assert min(counts.values()) > 4000 / 4 * 0.7
+
+
+def test_shard_owner():
+    table = {"a": (1, 3), "b": (2,), "c": ()}
+    assert shard_owner(1, table) == "a"
+    assert shard_owner(2, table) == "b"
+    assert shard_owner(9, table) == "c"      # catch-all
+    assert shard_owner(9, {"a": (1,)}) is None
+
+
+# ---------------------------------------------------------------------------
+# IPC codec
+# ---------------------------------------------------------------------------
+
+
+def test_ipc_codec_roundtrip():
+    hello = ipc.encode_hello("edge", "abcd1234", (1, 2, 7))
+    assert ipc.decode_hello(hello) == ("edge", "abcd1234", (1, 2, 7))
+
+    rec = ipc.encode_record(b"\xaa" * 32, 2, 3, 1234567, b"\xbb" * 32,
+                            b"payload bytes")
+    (h, type_, stream, expires, tag, payload), end = \
+        ipc.decode_record(rec)
+    assert (h, type_, stream, expires, tag, payload) == (
+        b"\xaa" * 32, 2, 3, 1234567, b"\xbb" * 32, b"payload bytes")
+    assert end == len(rec)
+
+    frame = ipc.encode_objects(42, [rec, rec])
+    seq, records = ipc.decode_objects(frame)
+    assert seq == 42 and len(records) == 2
+    assert records[1][5] == b"payload bytes"
+
+    ack = ipc.encode_objects_ack(42, 10, 2, 1)
+    assert ipc.decode_objects_ack(ack) == (42, 10, 2, 1)
+
+    inv = ipc.encode_inv([(1, 99, b"\xcc" * 32), (2, 100, b"\xdd" * 32)])
+    assert ipc.decode_inv(inv) == [(1, 99, b"\xcc" * 32),
+                                   (2, 100, b"\xdd" * 32)]
+
+    assert ipc.decode_fetch(ipc.encode_fetch(b"\xee" * 32)) == b"\xee" * 32
+
+
+def test_ipc_codec_rejects_truncation_and_junk():
+    rec = ipc.encode_record(b"\x01" * 32, 2, 1, 5, b"", b"xyz")
+    for cut in (3, 10, len(rec) - 1):
+        with pytest.raises(ipc.IPCError):
+            ipc.decode_record(rec[:cut])
+    with pytest.raises(ipc.IPCError):
+        ipc.decode_objects(ipc.encode_objects(1, [rec])[:-2])
+    with pytest.raises(ipc.IPCError):
+        ipc.decode_hello(b"\x05edge")          # truncated strings
+    with pytest.raises(ipc.IPCError):
+        ipc.parse_header(b"\x00\x00\x01\x03\x00\x00\x00\x00")  # magic
+    with pytest.raises(ipc.IPCError):
+        ipc.parse_header(ipc.HEADER.pack(ipc.MAGIC, 99, 1, 0))  # version
+    with pytest.raises(ipc.IPCError):
+        ipc.pack_frame(ipc.MSG_PING, b"\x00" * (ipc.MAX_FRAME + 1))
+
+
+# ---------------------------------------------------------------------------
+# edge cache
+# ---------------------------------------------------------------------------
+
+
+def test_edge_cache_contract():
+    cache = EdgeCache(max_bytes=300)
+    now = int(time.time())
+    cache.add(b"\x01" * 32, 2, 1, b"x" * 100, now + 100, b"")
+    cache.add(b"\x02" * 32, 2, 1, b"y" * 100, now + 100, b"t" * 32)
+    assert b"\x01" * 32 in cache and len(cache) == 2
+    assert cache[b"\x02" * 32].payload == b"y" * 100
+    assert cache[b"\x02" * 32].tag == b"t" * 32
+    # duplicate add is a no-op
+    cache.add(b"\x01" * 32, 2, 1, b"z" * 100, now + 100, b"")
+    assert cache[b"\x01" * 32].payload == b"x" * 100
+    # eviction past the byte budget sheds the payload but KEEPS the
+    # hash known — dedupe survives
+    cache.add(b"\x03" * 32, 2, 1, b"z" * 200, now + 100, b"")
+    assert b"\x01" * 32 in cache
+    assert cache.is_known_uncached(b"\x01" * 32)
+    with pytest.raises(KeyError):
+        cache[b"\x01" * 32]
+    # INV-delta knowledge
+    cache.note_known(b"\x04" * 32, 2, now + 50)
+    assert b"\x04" * 32 in cache
+    assert cache.known_stream(b"\x04" * 32) == 2
+    hashes1 = cache.unexpired_hashes_by_stream(1)
+    assert b"\x03" * 32 in hashes1 and b"\x01" * 32 in hashes1
+    assert cache.unexpired_hashes_by_stream(2) == [b"\x04" * 32]
+    assert cache.by_type_and_tag(2, b"t" * 32)
+    # clean drops expired items and known entries
+    cache.note_known(b"\x05" * 32, 1, now - 10)
+    dropped = cache.clean()
+    assert dropped >= 1 and b"\x05" * 32 not in cache
+    cache.flush()  # no-op, part of the inventory contract
+
+
+# ---------------------------------------------------------------------------
+# config knobs (ISSUE 14 satellite: validators + persistence)
+# ---------------------------------------------------------------------------
+
+
+def test_role_knob_validators():
+    from pybitmessage_tpu.core.config import Settings, SettingsError
+    s = Settings()
+    s.set("role", "edge")
+    s.set("role", "relay")
+    s.set("role", "all")
+    with pytest.raises(SettingsError):
+        s.set("role", "spaghetti")
+    s.set("rolestreams", "1,2,3")
+    s.set("rolestreams", "")
+    with pytest.raises(SettingsError):
+        s.set("rolestreams", "1,zebra")
+    with pytest.raises(SettingsError):
+        s.set("rolestreams", "0")
+    s.set("edgeprocs", 4)
+    with pytest.raises(SettingsError):
+        s.set("edgeprocs", 0)
+    with pytest.raises(SettingsError):
+        s.set("edgeprocs", 65)
+    s.set("roleipclisten", "8460")
+    s.set("roleipclisten", "127.0.0.1:8460")
+    s.set("roleipclisten", "")
+    with pytest.raises(SettingsError):
+        s.set("roleipclisten", "127.0.0.1:notaport")
+    s.set("roleipcconnect", "127.0.0.1:8460")
+    s.set("roleipcconnect", "127.0.0.1:8460,10.0.0.2:8461")
+    s.set("roleipcconnect", "")
+    with pytest.raises(SettingsError):
+        s.set("roleipcconnect", "127.0.0.1:0")
+    with pytest.raises(SettingsError):
+        s.set("roleipcconnect", "host:port")
+
+
+def test_role_knobs_persist(tmp_path):
+    from pybitmessage_tpu.core.config import Settings
+    path = tmp_path / "settings.dat"
+    s = Settings(path)
+    s.set("role", "relay")
+    s.set("rolestreams", "2,4")
+    s.set("edgeprocs", 8)
+    s.set("roleipclisten", "127.0.0.1:8460")
+    s.set("roleipcconnect", "127.0.0.1:8460,127.0.0.1:8461")
+    s.save()
+    reloaded = Settings(path)
+    assert reloaded.get("role") == "relay"
+    assert parse_role_streams(reloaded.get("rolestreams")) == (2, 4)
+    assert reloaded.getint("edgeprocs") == 8
+    assert reloaded.get("roleipclisten") == "127.0.0.1:8460"
+    assert reloaded.get("roleipcconnect") == \
+        "127.0.0.1:8460,127.0.0.1:8461"
+
+
+def test_edge_role_requires_connect():
+    from pybitmessage_tpu.core.node import Node
+    with pytest.raises(ValueError):
+        Node(None, port=0, listen=False, test_mode=True,
+             tls_enabled=False, role="edge")
+    with pytest.raises(ValueError):
+        Node(None, port=0, listen=False, test_mode=True,
+             tls_enabled=False, role="relay")  # needs roleipclisten
+
+
+# ---------------------------------------------------------------------------
+# digest / reconciler shard boundary (ISSUE 14 satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_digest_stream_restriction():
+    from pybitmessage_tpu.sync.digest import InventoryDigest
+    d = InventoryDigest(streams={1})
+    d.add(b"\x01" * 32, 1, 10 ** 10)
+    d.add(b"\x02" * 32, 2, 10 ** 10)   # out-of-shard: never folded
+    assert len(d) == 1
+    assert d.hashes_by_stream(2) == []
+    assert all(c == 0 for c, _ in d.summaries(2))
+    # unrestricted digest keeps the historical behavior
+    d2 = InventoryDigest()
+    d2.add(b"\x02" * 32, 2, 10 ** 10)
+    assert len(d2) == 1
+
+
+async def test_reconciler_shard_guard():
+    """An announcement for a stream outside the subscribed shard never
+    enters a pending set (pending feeds sketches) nor a tracker."""
+    from pybitmessage_tpu.network.pool import ConnectionPool, NodeContext
+    from pybitmessage_tpu.storage import Inventory
+    from pybitmessage_tpu.storage.db import Database
+    from pybitmessage_tpu.storage.knownnodes import KnownNodes
+    from pybitmessage_tpu.sync import Reconciler
+
+    db = Database()
+    ctx = NodeContext(inventory=Inventory(db),
+                      knownnodes=KnownNodes(None), streams=(1,))
+    pool = ConnectionPool(ctx)
+    rec = Reconciler(pool)
+    pool.reconciler = rec
+
+    class _Conn:
+        def __init__(self):
+            from pybitmessage_tpu.network.tracker import ConnectionTracker
+            self.tracker = ConnectionTracker()
+            self.fully_established = True
+            self.streams = (1,)
+            self.host, self.port = "t", 0
+    conn = _Conn()
+    s = rec.register(conn)
+    rec.route_announcement(b"\x0a" * 32, [conn], stream=1)
+    assert b"\x0a" * 32 in s.pending or \
+        conn.tracker.pending_announcements()
+    before_pending = dict(s.pending)
+    rec.route_announcement(b"\x0b" * 32, [conn], stream=2)
+    assert b"\x0b" * 32 not in s.pending
+    assert s.pending == before_pending
+    # the pool-level guard: out-of-shard streams are never routed
+    pool._route_announcement(b"\x0c" * 32, [conn], stream=2)
+    assert b"\x0c" * 32 not in s.pending
+    db.close()
+
+
+async def test_pool_stream_overlay_routing():
+    """Announcements honor the per-stream overlay: a peer subscribed
+    to stream 2 only never hears stream-1 objects."""
+    from pybitmessage_tpu.network.pool import ConnectionPool, NodeContext
+    from pybitmessage_tpu.network.tracker import ConnectionTracker
+    from pybitmessage_tpu.storage import Inventory
+    from pybitmessage_tpu.storage.db import Database
+    from pybitmessage_tpu.storage.knownnodes import KnownNodes
+
+    db = Database()
+    ctx = NodeContext(inventory=Inventory(db),
+                      knownnodes=KnownNodes(None), streams=(1, 2))
+    pool = ConnectionPool(ctx)
+
+    class _Conn:
+        def __init__(self, streams):
+            self.tracker = ConnectionTracker()
+            self.fully_established = True
+            self.streams = streams
+            self.host, self.port = "t", 0
+    c1, c2 = _Conn((1,)), _Conn((2,))
+    pool._route_announcement(b"\x01" * 32, [c1, c2], stream=1)
+    assert c1.tracker.pending_announcements() == 1
+    assert c2.tracker.pending_announcements() == 0
+    pool._route_announcement(b"\x02" * 32, [c1, c2], stream=2)
+    assert c2.tracker.pending_announcements() == 1
+    db.close()
+
+
+# ---------------------------------------------------------------------------
+# in-process edge <-> relay over real TCP + role IPC
+# ---------------------------------------------------------------------------
+
+
+async def test_edge_relay_end_to_end():
+    """Objects over real TCP -> edge framing/PoW -> IPC -> relay
+    inventory; redelivery dedupes; roleStatus + health blocks report
+    the deployment."""
+    payloads = build_msg_objects(24)
+    relay = make_relay()
+    await relay.start()
+    edge = make_edge([relay.role_runtime.listen_port])
+    await edge.start()
+    client = None
+    try:
+        await wait_for(lambda: edge.role_runtime.links[0].connected,
+                       what="edge link")
+        client = await WireClient().connect(edge.pool.listen_port)
+        await client.send_objects(payloads)
+        await wait_for(lambda: len(relay.inventory) == len(payloads),
+                       what="relay ingest")
+        snap = relay.role_runtime.snapshot()
+        assert snap["accepted"] == len(payloads)
+        assert snap["rejected"] == 0
+        # redelivery (the at-least-once path) is idempotent
+        await client.send_objects(payloads[:8])
+        link = edge.role_runtime.links[0]
+        await asyncio.sleep(0.3)
+        assert len(relay.inventory) == len(payloads)
+        # edge-side dedupe recognizes them without a relay round-trip
+        assert link.acked_objects == len(payloads)
+
+        # roleStatus (API) on both sides
+        import json
+
+        from pybitmessage_tpu.api.commands import CommandHandler
+        edge_status = json.loads(await CommandHandler(edge).dispatch(
+            "roleStatus", []))
+        assert edge_status["role"] == "edge"
+        assert edge_status["ipc"]["links"][0]["acked"] == len(payloads)
+        relay_status = json.loads(await CommandHandler(relay).dispatch(
+            "roleStatus", []))
+        assert relay_status["role"] == "relay"
+        assert relay_status["inventoryObjects"] == len(payloads)
+        assert relay_status["ipc"]["accepted"] == len(payloads)
+
+        # per-role health verdicts (ride every federation push)
+        eh = edge.health.health_block()
+        assert eh["role"]["name"] == "edge"
+        assert eh["role"]["status"] == "ok"
+        rh = relay.health.health_block()
+        assert rh["role"]["name"] == "relay"
+    finally:
+        if client is not None:
+            await client.close()
+        await edge.stop()
+        await relay.stop()
+
+
+async def test_stream_sharded_two_relays():
+    """Stream sharding (tentpole b): two relays own streams {1} and
+    {2}; the edge routes by object stream — learned dynamically from
+    HELLO_ACK, never configured.  Objects never cross shards, and the
+    shard digests stay pure."""
+    s1 = build_msg_objects(6, stream=1)
+    s2 = build_msg_objects(5, stream=2)
+    relay_a = make_relay(streams=(1,))
+    relay_b = make_relay(streams=(2,))
+    await relay_a.start()
+    await relay_b.start()
+    edge = make_edge([relay_a.role_runtime.listen_port,
+                      relay_b.role_runtime.listen_port],
+                     streams=(1, 2))
+    await edge.start()
+    client = None
+    try:
+        await wait_for(lambda: all(lk.connected
+                                   for lk in edge.role_runtime.links),
+                       what="edge links")
+        # routing table learned from HELLO_ACKs
+        assert edge.role_runtime.link_for(1).relay_streams == (1,)
+        assert edge.role_runtime.link_for(2).relay_streams == (2,)
+        client = await WireClient().connect(edge.pool.listen_port,
+                                            streams=(1, 2))
+        await client.send_objects(s1 + s2)
+        await wait_for(lambda: len(relay_a.inventory) == len(s1)
+                       and len(relay_b.inventory) == len(s2),
+                       what="sharded ingest")
+        # no cross-shard leakage in the stores
+        assert relay_a.inventory.unexpired_hashes_by_stream(2) == []
+        assert relay_b.inventory.unexpired_hashes_by_stream(1) == []
+        # ... nor in the sync digests (the sketch/catch-up boundary)
+        assert len(relay_a.sync_digest) == len(s1)
+        assert relay_a.sync_digest.hashes_by_stream(2) == []
+        assert len(relay_b.sync_digest) == len(s2)
+        assert relay_b.sync_digest.hashes_by_stream(1) == []
+        # even a leaked out-of-shard store row cannot reach the digest
+        # or the catch-up population
+        relay_a.inventory.add(b"\x77" * 32, 2, 2, b"leak",
+                              int(time.time()) + 500, b"")
+        assert relay_a.sync_digest.hashes_by_stream(2) == []
+        assert b"\x77" * 32 not in relay_a.reconciler._catchup_population()
+        # a mis-routed record is refused at the relay, not absorbed
+        rejected_before = relay_b.role_runtime.objects_rejected
+        rec = ipc.decode_record(ipc.encode_record(
+            b"\x78" * 32, 2, 1, int(time.time()) + 500, b"", b"x"))[0]
+        assert relay_b.role_runtime._accept_record(rec, None) == \
+            "rejected"
+        assert relay_b.role_runtime.objects_rejected == rejected_before
+    finally:
+        if client is not None:
+            await client.close()
+        await edge.stop()
+        await relay_a.stop()
+        await relay_b.stop()
+
+
+async def test_relay_push_and_edge_fetch_serve_getdata():
+    """Relay->edge OBJECT_PUSH (local announce) and the FETCH path: an
+    edge that only knows a hash from an INV delta fetches the payload
+    over IPC and serves the peer's getdata."""
+    from pybitmessage_tpu.network.messages import decode_inv, encode_inv
+    from pybitmessage_tpu.utils.hashes import inventory_hash
+
+    relay = make_relay()
+    await relay.start()
+    edge1 = make_edge([relay.role_runtime.listen_port])
+    edge2 = make_edge([relay.role_runtime.listen_port])
+    await edge1.start()
+    await edge2.start()
+    c1 = c2 = None
+    try:
+        await wait_for(lambda: edge1.role_runtime.links[0].connected
+                       and edge2.role_runtime.links[0].connected,
+                       what="edge links")
+        # (a) ingest through edge1; edge2 learns the hash via INV delta
+        payloads = build_msg_objects(3)
+        hashes = [inventory_hash(p) for p in payloads]
+        c1 = await WireClient().connect(edge1.pool.listen_port)
+        await c1.send_objects(payloads)
+        await wait_for(lambda: all(h in edge2.inventory for h in hashes),
+                       what="inv delta reaches edge2")
+        assert edge2.inventory.is_known_uncached(hashes[0])
+        # (b) a peer on edge2 getdata's it: FETCH -> OBJECT_PUSH -> serve
+        c2 = await WireClient().connect(edge2.pool.listen_port)
+        await c2.send_packet("getdata", encode_inv([hashes[0]]))
+        obj = await c2.expect("object", timeout=15.0)
+        assert bytes(obj) == payloads[0]
+        # (c) relay-originated object (local announce) is PUSHED with
+        # payload to the edges, which announce it to their peers
+        local = build_msg_objects(1)[0]
+        lh = inventory_hash(local)
+        relay.inventory.add(lh, 2, 1, local, int(time.time()) + 900, b"")
+        relay.pool.announce_object(lh, 1, local=False)  # no stem phase
+        relay.role_runtime._on_announce(lh, 1, True)
+        await wait_for(lambda: lh in edge1.inventory
+                       and not edge1.inventory.is_known_uncached(lh),
+                       what="object push reaches edge1")
+        inv_payload = await c1.expect("inv", timeout=15.0)
+        assert lh in decode_inv(inv_payload)
+        await c1.send_packet("getdata", encode_inv([lh]))
+        served = await c1.expect("object", timeout=15.0)
+        assert bytes(served) == local
+    finally:
+        for c in (c1, c2):
+            if c is not None:
+                await c.close()
+        await edge1.stop()
+        await edge2.stop()
+        await relay.stop()

@@ -117,6 +117,21 @@ GUARDS: list[tuple[str, str, float]] = [
     ("configs.pow_farm.zero_job_loss", "equal", 0.0),
     ("configs.pow_farm.fairness.max_min_ratio", "atmost", 1.5),
     ("configs.pow_farm.lane_p99_split", "atleast", 3.0),
+    # role-split node (ISSUE 14): zero objects lost across BOTH
+    # deployments (hard invariant), the split deployment's end-to-end
+    # accepted rate (wall-clock: generous band), and a sanity floor on
+    # the split/fused ratio.  Smoke runs 1 edge + 1 relay — the extra
+    # IPC hop without the parallelism — so the honest smoke bar is
+    # only "not catastrophically slower than fused"; the >=2x 4-edge
+    # scaling assertion lives in bench.py full mode.
+    ("configs.role_split.zero_objects_lost", "equal", 0.0),
+    ("configs.role_split.split.objects_per_s", "higher", 0.60),
+    ("configs.role_split.ratio_vs_fused", "atleast", 0.25),
+    # ingest through the role-split path on a wide keyring (ISSUE 14
+    # satellite): delivery-complete rate band + the loss invariant
+    ("configs.ingest_storm.wide_host.objects_per_s", "higher", 0.60),
+    ("configs.ingest_storm.wide_host.zero_objects_lost",
+     "equal", 0.0),
     # sync: machine-independent bandwidth ratios + the loss invariant
     ("configs.sync_storm.announce_reduction_x", "higher", 0.30),
     ("configs.sync_storm.catchup_reduction_x", "higher", 0.30),
