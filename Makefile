@@ -3,7 +3,7 @@
 # pybitmessage_tpu/pow/native.py when missing or stale.
 
 .PHONY: all native test bench bench-smoke chaos perfguard lint \
-	roles-smoke clean
+	roles-smoke profile-smoke clean
 
 all: native
 
@@ -52,6 +52,16 @@ bench-smoke:
 #   python tools/bench_compare.py --run --update
 perfguard:
 	python tools/bench_compare.py --run
+
+# continuous-profiling smoke (docs/observability.md "Continuous
+# profiling"): the sampler must classify threads/subsystems correctly,
+# cost <2% on the ingest smoke path (same harness shape as the PR 1
+# tracing-overhead gate), attribute loop-lag culprits, and serve
+# profileDump/costStatus — plus the profile_merge / flightrec_merge
+# profile-block tests.  CI-runnable, no TPU.
+profile-smoke:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_profiling.py \
+		-q -m 'not slow'
 
 # role-split smoke (docs/roles.md): spawn edge+relay as REAL daemon
 # subprocesses, deliver one message end to end over TCP through the

@@ -32,6 +32,7 @@ import os
 import statistics
 import sys
 import time
+from contextlib import contextmanager
 
 from pybitmessage_tpu.observability import (REGISTRY, enable_jax_annotations,
                                             snapshot, trace)
@@ -39,6 +40,35 @@ from pybitmessage_tpu.observability import (REGISTRY, enable_jax_annotations,
 LANES = 1 << 19
 CHUNKS = 64
 REPS = 5
+
+#: continuous profiling plane (docs/observability.md): ``--profile``
+#: makes the attributed sections (ingest_storm, role_split, pow_farm)
+#: write a speedscope JSON next to their metrics snapshot; the
+#: attribution dicts ride the bench JSON either way
+PROFILE = "--profile" in sys.argv[1:]
+PROFILE_DIR = os.environ.get("BMTPU_PROFILE_DIR", ".")
+
+
+@contextmanager
+def _attributed(section: str, hz: float = 47.0):
+    """CPU attribution window around one bench section: a dedicated
+    sampling profiler measures the body and the yielded dict fills
+    with subsystem/thread-class shares, the dominant subsystem, the
+    sampler's own overhead fraction (perfguard-banded <2%), and —
+    under ``--profile`` — the path of the emitted speedscope file."""
+    from pybitmessage_tpu.observability.profiling import (
+        SamplingProfiler, speedscope_doc)
+    prof = SamplingProfiler(hz=hz)
+    with prof.measure() as att:
+        yield att
+    att["crypto_share"] = att.get("by_subsystem", {}).get("crypto", 0.0)
+    if PROFILE:
+        path = os.path.join(PROFILE_DIR,
+                            "profile_%s.speedscope.json" % section)
+        with open(path, "w") as f:
+            json.dump(speedscope_doc(prof.collapsed(),
+                                     name=section), f)
+        att["speedscope_file"] = path
 
 #: device-side kernel time per production slab, fed from the profiler
 #: trace in _measure_mfu — the histogram form of the quantity MFU is
@@ -1125,15 +1155,29 @@ def _bench_ingest_storm(identities: int = 8, objects: int = 400,
             "crypto_rung": engine.last_path if engine else "per-call",
         }
 
-    pipe = asyncio.run(run(True))
+    with _attributed("ingest_storm") as pipe_att:
+        pipe = asyncio.run(run(True))
+    pipe["attribution"] = pipe_att
     e2e_slab = asyncio.run(run_e2e_slab())
     # full mode: 1000 identities is the "wide host" bar; the measured
     # rate is ECDH-bound (a foreign msg costs one trial decrypt per
     # candidate key — linear in keyring size), which is the
     # quantified motivation for per-address filter digests / light
     # clients (ROADMAP item 4's remaining piece)
-    wide_host = asyncio.run(run_wide_host(
-        *((32, 96) if smoke else (1000, 1000))))
+    with _attributed("ingest_storm_wide_host") as wh_att:
+        wide_host = asyncio.run(run_wide_host(
+            *((32, 96) if smoke else (1000, 1000))))
+    # the continuous-attribution consistency check against the PR 14
+    # bench finding: the wide-host run IS ECDH-bound, so the sampler
+    # must name crypto as the dominant subsystem (full mode asserts;
+    # the smoke band guards crypto_share in perfguard)
+    wide_host["attribution"] = wh_att
+    if not smoke:
+        assert wh_att.get("dominant_subsystem") == "crypto", (
+            "wide_host attribution names %r dominant, expected the "
+            "ECDH-bound crypto subsystem (shares: %r)"
+            % (wh_att.get("dominant_subsystem"),
+               wh_att.get("by_subsystem")))
     # honest pre-PR baseline: no key cache, and no native batch engine
     # either — the inline path runs the exact per-call ladder the code
     # before this engine ran (`cryptography` EVP calls where installed,
@@ -1169,6 +1213,10 @@ def _bench_ingest_storm(identities: int = 8, objects: int = 400,
         # remnant): edge Node -> role IPC -> relay Node with the full
         # wavefront trial-decrypt sweep per foreign object
         "wide_host": wide_host,
+        # continuous-profiler attribution over the pipelined run
+        # (ISSUE 15): subsystem CPU shares + the sampler's own <2%
+        # overhead fraction, perfguard-banded
+        "attribution": pipe_att,
         "speedup_vs_inline": round(
             pipe["objects_per_s"] / max(inline["objects_per_s"], 1e-9), 2),
         # acceptance (ISSUE 7): the batch engine's combined
@@ -1819,7 +1867,23 @@ def _bench_pow_farm(tenants: int = 8, seconds: float = 6.0,
         return out
 
     try:
-        out = asyncio.run(run())
+        from pybitmessage_tpu.observability.profiling import \
+            farm_tenant_costs
+        cpu0 = {t: v["value"]
+                for t, v in farm_tenant_costs().items()}
+        with _attributed("pow_farm") as farm_att:
+            out = asyncio.run(run())
+        # per-tenant CPU attribution over this run (ISSUE 15): the
+        # farm splits each batch's solve seconds by tenant job share
+        # (farm_tenant_cpu_seconds_total) — the deltas are the run's
+        # own cost table
+        tenant_cpu = {
+            t: round(v["value"] - cpu0.get(t, 0.0), 4)
+            for t, v in farm_tenant_costs().items()}
+        accounted = sum(tenant_cpu.values())
+        farm_att["tenant_cpu_s"] = dict(sorted(tenant_cpu.items()))
+        farm_att["tenant_cpu_accounted_s"] = round(accounted, 3)
+        out["attribution"] = farm_att
     finally:
         if tmp is not None and os.path.exists(tmp.name):
             os.unlink(tmp.name)
@@ -2106,6 +2170,32 @@ def _run_role_deployment(payloads, *, edge_procs: int, clients: int,
 
         accepted, wall = asyncio.run(drive())
 
+        # continuous profiling plane (ISSUE 15): pull each authority
+        # daemon's LIVE cost attribution over JSON-RPC — the per-role
+        # subsystem CPU shares of the run just measured, plus a
+        # profileDump sample proving the dump path end to end
+        attribution = []
+        for port in api_ports:
+            try:
+                cost = json.loads(_role_rpc(port, "costStatus"))
+                prof = json.loads(_role_rpc(port, "profileDump",
+                                            0, "collapsed"))
+                attribution.append({
+                    "role": cost.get("role"),
+                    "samplerRunning": cost["sampler"]["running"],
+                    "overheadFrac": cost["sampler"]["overheadFrac"],
+                    "subsystems": {
+                        k: v["share"]
+                        for k, v in cost["cpu"]["subsystems"].items()},
+                    "profileSamples": prof.get("samples", 0),
+                })
+            except (OSError, RuntimeError, KeyError, ValueError,
+                    TypeError) as exc:
+                # a daemon mid-shutdown can return torn JSON or a
+                # partial doc — degrade to a per-port error, never
+                # kill the whole role_split section
+                attribution.append({"error": repr(exc)[:120]})
+
         clean = True
         for p in procs:
             p.send_signal(signal.SIGTERM)
@@ -2126,6 +2216,9 @@ def _run_role_deployment(payloads, *, edge_procs: int, clients: int,
             "wall_s": round(wall, 3),
             "objects_per_s": round(accepted / max(wall, 1e-9), 1),
             "clean_shutdown": clean,
+            # per-authority-daemon cost attribution, served live over
+            # JSON-RPC by the daemons' own continuous profilers
+            "attribution": attribution,
         }
     finally:
         for p in procs:

@@ -147,6 +147,15 @@ async def run(args) -> int:
     FLIGHT_RECORDER.resize(settings.getint("flightrecsize"))
     node.health.sample_interval = settings.getfloat("healthinterval")
     node.health.probe.interval = settings.getfloat("looplaginterval")
+    # continuous profiling plane: always-on CPU/cost attribution at a
+    # low default rate — costStatus / profileDump / GET /debug/profile
+    # serve it live, federation carries the cpu_samples_total shares
+    # fleet-wide, and the flight recorder's stall dumps gain the
+    # stacks of the stall (docs/observability.md)
+    if settings.getbool("profiling"):
+        from .observability import PROFILER
+        PROFILER.hz = settings.getfloat("profilehz")
+        PROFILER.start()
     # distributed observability plane (docs/observability.md): hashed
     # peer-bucket label count, snapshot push cadence, optional parent
     # aggregator this node federates its own registry up to

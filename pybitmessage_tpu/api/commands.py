@@ -783,6 +783,39 @@ class CommandHandler:
                            "skew": round(FLIGHT_RECORDER.skew(), 6),
                            "events": events}, default=repr)
 
+    def cmd_costStatus(self):
+        """CPU/cost attribution (docs/observability.md "Continuous
+        profiling"): sampler state, subsystem/thread-class CPU-sample
+        shares, CPU-µs/object per ingest stage, per-tenant farm CPU
+        share, per-rung crypto-ladder share — the continuous answer to
+        "where does the CPU go?" that previously took a bespoke
+        bench."""
+        from ..observability import cost_status
+        return json.dumps(cost_status(self.node), indent=4)
+
+    async def cmd_profileDump(self, seconds=0, fmt=""):
+        """Dump the continuous profiler: collapsed folded stacks plus
+        a speedscope document (paste into speedscope.app), classified
+        by thread class.  ``seconds > 0`` dumps the rolling window of
+        the last N seconds (the stall-forensics view); 0 dumps the
+        whole-run bounded trie.  ``fmt="collapsed"`` omits the
+        speedscope rendering.  The same document is served as
+        ``GET /debug/profile?seconds=N``; merge many nodes' dumps
+        with ``tools/profile_merge.py``."""
+        try:
+            seconds = float(seconds)
+        except (TypeError, ValueError):
+            raise APIError(0, "seconds must be numeric")
+        from ..observability import PROFILER
+        win = seconds if seconds > 0 else None
+        node_id = getattr(self.node, "node_id", "")
+        # trie walk + speedscope build + serialization scale with the
+        # whole-run profile: off the event loop
+        return await asyncio.get_running_loop().run_in_executor(
+            None, lambda: json.dumps(PROFILER.dump(
+                win, speedscope=fmt != "collapsed",
+                node_id=node_id), default=repr))
+
     def cmd_objectTimeline(self, hash_hex):
         """Lifecycle timeline of one inventory hash: the recorded
         stage events (received/parsed/decrypted/verified/stored/

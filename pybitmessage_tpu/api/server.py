@@ -131,9 +131,45 @@ class APIServer:
             body = await reader.readexactly(length) if length else b""
 
             if request_line.startswith(b"GET"):
-                path = request_line.split()[1].decode("latin-1", "replace") \
+                raw_path = request_line.split()[1].decode(
+                    "latin-1", "replace") \
                     if len(request_line.split()) > 1 else ""
-                path = path.split("?")[0]
+                path, _, query = raw_path.partition("?")
+                if path == "/debug/profile":
+                    # the continuous profiler's dump (collapsed +
+                    # speedscope JSON; docs/observability.md).
+                    # ?seconds=N dumps the rolling window of the last
+                    # N seconds instead of the whole-run trie.
+                    if not self._authorized(headers):
+                        await self._respond(
+                            writer, 401, {"error": "unauthorized"},
+                            extra="WWW-Authenticate: Basic\r\n")
+                        return
+                    seconds = None
+                    for part in query.split("&"):
+                        k, _, v = part.partition("=")
+                        if k == "seconds":
+                            try:
+                                seconds = float(v)
+                            except ValueError:
+                                await self._respond(
+                                    writer, 400,
+                                    {"error": "bad seconds"})
+                                return
+                    from ..observability import PROFILER
+                    # the whole-run trie can be tens of thousands of
+                    # nodes: walk + speedscope + serialize on the
+                    # executor, not the event loop (the loop-lag
+                    # probe would otherwise name THIS endpoint)
+                    win = seconds if seconds and seconds > 0 else None
+                    node_id = getattr(self.node, "node_id", "")
+                    body_bytes = await asyncio.get_running_loop() \
+                        .run_in_executor(None, lambda: json.dumps(
+                            PROFILER.dump(win, node_id=node_id)
+                        ).encode("utf-8"))
+                    await self._respond_raw(writer, 200, body_bytes,
+                                            "application/json")
+                    return
                 if path in ("/metrics", "/metrics/federated"):
                     if not self._authorized(headers):
                         await self._respond(

@@ -195,6 +195,14 @@ DEFAULTS: dict[str, str] = {
                                      # "pow.device_launch:0.5,db.write:1x3"
     "chaosseed": "0",                # deterministic chaos seed
     # -- observability (docs/observability.md) --
+    "profiling": "true",             # continuous sampling profiler
+                                     # (always-on CPU/cost attribution;
+                                     # costStatus / profileDump /
+                                     # GET /debug/profile)
+    "profilehz": "19",               # profiler sampling rate, Hz —
+                                     # low by default; each tick costs
+                                     # tens of µs (<2% budget gated by
+                                     # make profile-smoke)
     "flightrecsize": "512",          # flight-recorder ring capacity
                                      # (events)
     "healthinterval": "5",           # health-gauge sampling cadence,
@@ -369,6 +377,8 @@ VALIDATORS: dict[str, Callable[[str], bool]] = {
     "connecttimeout": _validate_float_range(1.0, 300.0),
     "handshaketimeout": _validate_float_range(1.0, 3600.0),
     "chaosseed": _validate_int_range(0, 2**63 - 1),
+    "profiling": _validate_bool,
+    "profilehz": _validate_float_range(0.1, 1000.0),
     "flightrecsize": _validate_int_range(16, 1 << 20),
     "healthinterval": _validate_float_range(0.1, 3600.0),
     "looplaginterval": _validate_float_range(0.01, 60.0),

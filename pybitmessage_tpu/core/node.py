@@ -281,6 +281,12 @@ class Node:
     # -- lifecycle -----------------------------------------------------------
 
     async def start(self) -> None:
+        # tell the continuous profiler which thread runs the event
+        # loop — event_loop thread-class attribution and the slow-
+        # callback culprit probe key off it (the daemon entry point
+        # starts the sampler itself; docs/observability.md)
+        from ..observability import PROFILER
+        PROFILER.note_loop_thread()
         if self.pow_service is not None:
             self.pow_service.start()
         self.pow_verifier.start()

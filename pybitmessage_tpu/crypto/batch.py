@@ -77,6 +77,11 @@ BATCH_SECONDS = REGISTRY.histogram(
 BATCH_OPS = REGISTRY.counter(
     "crypto_batch_ops_total",
     "Batched crypto items by op and execution path", ("op", "path"))
+RUNG_SECONDS = REGISTRY.counter(
+    "crypto_rung_seconds_total",
+    "Drain work seconds accumulated per crypto-ladder rung "
+    "(tpu/native/pure) — the per-rung half of the costStatus "
+    "attribution plane", ("rung",))
 NATIVE_FALLBACKS = REGISTRY.counter(
     "crypto_native_fallback_total",
     "Drains whose native batch attempt failed and re-ran on the pure "
@@ -319,6 +324,7 @@ class BatchCryptoEngine:
         if decrypts:
             BATCH_SECONDS.labels(op="decrypt").observe(
                 time.monotonic() - tv)
+        RUNG_SECONDS.labels(rung=path).inc(time.monotonic() - t0)
         breaker.record_success()
         setattr(self, path + "_items",
                 getattr(self, path + "_items")
@@ -375,6 +381,7 @@ class BatchCryptoEngine:
         if decrypts:
             BATCH_SECONDS.labels(op="decrypt").observe(
                 time.monotonic() - tv)
+        RUNG_SECONDS.labels(rung="pure").inc(time.monotonic() - t0)
         self.pure_items += len(verifies) + len(decrypts)
         self._count(verifies, decrypts, "pure")
         self.last_path = "pure"

@@ -15,6 +15,7 @@ from .lifecycle import LIFECYCLE, LifecycleTracer
 from .metrics import (DEFAULT_LATENCY_BUCKETS, DEFAULT_SIZE_BUCKETS,
                       REGISTRY, Counter, Gauge, Histogram, Registry,
                       peer_bucket, peer_bucket_label, set_peer_buckets)
+from .profiling import PROFILER, SamplingProfiler, cost_status
 from .tracing import (TRACE_CTX_LEN, TRACER, SkewEstimator, Span,
                       TraceContext, Tracer, current_span,
                       enable_jax_annotations, jax_annotations_enabled,
@@ -33,6 +34,7 @@ __all__ = [
     "LifecycleTracer", "LIFECYCLE",
     "FlightRecorder", "FLIGHT_RECORDER",
     "HealthMonitor", "LoopLagProbe",
+    "SamplingProfiler", "PROFILER", "cost_status",
     "Aggregator", "FederationPublisher", "FEDERATION_VERSION",
     "http_transport", "mergeable_snapshot",
 ]

@@ -61,6 +61,12 @@ class FlightRecorder:
         #: dump so tools/flightrec_merge.py can emit ONE skew-
         #: normalized timeline from many nodes' dumps.
         self.skew_provider = None
+        #: optional callable returning a rolling-window profile block
+        #: (``observability/profiling.py``): every dump then carries
+        #: the stacks of the seconds BEFORE the trigger — a stall
+        #: auto-dump shows what held the loop during the stall, not
+        #: the post-recovery aftermath
+        self.profile_provider = None
 
     def resize(self, maxlen: int) -> None:
         """Re-cap the ring, keeping the newest events."""
@@ -105,13 +111,31 @@ class FlightRecorder:
             logger.debug("flightrec skew provider failed", exc_info=True)
             return 0.0
 
+    def profile(self) -> dict | None:
+        """The rolling-window profile block (None when unwired or the
+        provider fails — a dump must never fail on telemetry)."""
+        if self.profile_provider is None:
+            return None
+        try:
+            block = self.profile_provider()
+            return block if isinstance(block, dict) else None
+        except Exception:
+            logger.debug("flightrec profile provider failed",
+                         exc_info=True)
+            return None
+
     def dump_record(self, trigger: str) -> dict:
         """The full dump structure: node identity + the federation
-        clock-skew estimate + the ring.  Multi-node dumps interleave
-        with raw local timestamps; the recorded ``skew`` is what lets
+        clock-skew estimate + the ring (+ the profiler's rolling
+        window when wired).  Multi-node dumps interleave with raw
+        local timestamps; the recorded ``skew`` is what lets
         ``tools/flightrec_merge.py`` normalize them onto one clock."""
-        return {"trigger": trigger, "node": self.node_id,
-                "skew": round(self.skew(), 6), "events": self.events()}
+        out = {"trigger": trigger, "node": self.node_id,
+               "skew": round(self.skew(), 6), "events": self.events()}
+        profile = self.profile()
+        if profile is not None:
+            out["profile"] = profile
+        return out
 
     def dump(self, trigger: str, *, log: logging.Logger | None = None
              ) -> list[dict]:

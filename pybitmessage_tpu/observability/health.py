@@ -54,6 +54,13 @@ class LoopLagProbe:
     ``await asyncio.sleep(interval)`` should resume ``interval``
     seconds later; any excess is time the loop spent running other
     callbacks (or blocked in C) — the lag.
+
+    Lag samples above ``culprit_threshold`` are no longer anonymous:
+    the probe asks the continuous profiler which site dominated the
+    event-loop thread during the late window and counts it into
+    ``event_loop_slow_callback_total{site}`` plus a flight-recorder
+    breadcrumb (``observability/profiling.py``; needs the sampler
+    running — without it the probe reports the bare number as before).
     """
 
     #: samples kept for the live-state window (~1 min at the default
@@ -62,10 +69,17 @@ class LoopLagProbe:
     WINDOW = 240
 
     def __init__(self, interval: float = DEFAULT_INTERVAL, *,
-                 histogram=LOOP_LAG):
+                 histogram=LOOP_LAG,
+                 culprit_threshold: float = LAG_DEGRADED_SECONDS):
         self.interval = interval
         self.histogram = histogram
+        self.culprit_threshold = culprit_threshold
         self.max_lag = 0.0
+        #: most recently ATTRIBUTED spike, (site, lag, wall time) —
+        #: surfaced in the health block with its age, and aged out of
+        #: the verdict entirely after CULPRIT_TTL (a stale name next
+        #: to a green loop would point operators at old data)
+        self.last_culprit: tuple[str, float, float] | None = None
         self.recent: deque = deque(maxlen=self.WINDOW)
         self._task: asyncio.Task | None = None
 
@@ -81,6 +95,36 @@ class LoopLagProbe:
             if lag > self.max_lag:
                 self.max_lag = lag
                 LOOP_LAG_MAX.set(lag)
+            if lag >= self.culprit_threshold:
+                self._attribute(lag)
+
+    #: seconds after which an attributed culprit stops being shown
+    CULPRIT_TTL = 900.0
+
+    def _attribute(self, lag: float) -> None:
+        """Name the callback that held the loop (never raises)."""
+        try:
+            import time as _time
+
+            from .profiling import PROFILER, note_slow_callback
+            site = PROFILER.loop_culprit(lag + self.interval)
+            if site is not None:
+                self.last_culprit = (site, lag, _time.time())
+                note_slow_callback(site, lag)
+        except Exception:
+            logger.debug("slow-callback attribution failed",
+                         exc_info=True)
+
+    def recent_culprit(self) -> tuple[str, float] | None:
+        """(site, lag) of the last attributed spike, or None once it
+        has aged past :data:`CULPRIT_TTL`."""
+        import time as _time
+        if self.last_culprit is None:
+            return None
+        site, lag, t = self.last_culprit
+        if _time.time() - t > self.CULPRIT_TTL:
+            return None
+        return site, lag
 
     def recent_p99(self) -> float:
         """p99 over the recent window (0.0 with no samples yet)."""
@@ -161,10 +205,16 @@ class HealthMonitor:
         # windowed, not the since-start histogram: the verdict must
         # flip when the loop wedges NOW, not 15 minutes later
         lag_p99 = self.probe.recent_p99()
+        culprit = self.probe.recent_culprit()
         out["loop"] = _verdict(
             lag_p99 <= LAG_DEGRADED_SECONDS,
             lagP99Ms=round(lag_p99 * 1e3, 2),
-            lagMaxMs=round(self.probe.max_lag * 1e3, 2))
+            lagMaxMs=round(self.probe.max_lag * 1e3, 2),
+            # the profiler-attributed site of the most recent
+            # above-threshold lag spike ("" until one crossed the
+            # threshold with the sampler running, and again once the
+            # attribution ages past the probe's TTL)
+            lastSlowCallback=culprit[0] if culprit else "")
 
         if node is None:
             return out

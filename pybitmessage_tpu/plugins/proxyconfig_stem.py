@@ -234,7 +234,7 @@ def _launch_private_tor(port: int, control: bool = False) -> bool:
                 bootstrapped.set()
 
     threading.Thread(target=drain, daemon=True,
-                     name="bmtor-log-drain").start()
+                     name="bmtpu-tor-log-drain").start()
     if bootstrapped.wait(BOOTSTRAP_TIMEOUT):
         logger.info("private tor bootstrapped on port %d", port)
         if control:

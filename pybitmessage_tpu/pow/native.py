@@ -102,7 +102,8 @@ class NativeSolver:
                     stop_flag.value = 1
                     return
 
-        watcher = threading.Thread(target=watch, daemon=True)
+        watcher = threading.Thread(target=watch, daemon=True,
+                                   name="bmtpu-pow-native-watch")
         watcher.start()
         try:
             nonce = self._lib.tpu_bm_pow_solve(

@@ -369,7 +369,7 @@ class _PipelineDriver:
         import concurrent.futures as cf
         if self._guard_pool is None:
             self._guard_pool = cf.ThreadPoolExecutor(
-                1, thread_name_prefix="pow-slab-guard")
+                1, thread_name_prefix="bmtpu-pow-slab-guard")
         fut = self._guard_pool.submit(self.fetch, dev)
         try:
             return fut.result(self.stall_timeout)

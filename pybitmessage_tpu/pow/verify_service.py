@@ -101,7 +101,7 @@ class BatchVerifier:
             def probe() -> None:
                 self._device_ok = _accelerator_backend()
             threading.Thread(target=probe, daemon=True,
-                             name="pow-verify-probe").start()
+                             name="bmtpu-pow-verify-probe").start()
         self._task = asyncio.create_task(self._run())
         return self._task
 

@@ -40,6 +40,7 @@ import itertools
 import logging
 import time
 from collections import OrderedDict
+from concurrent.futures import ThreadPoolExecutor
 
 from ..observability import DEFAULT_SIZE_BUCKETS, REGISTRY
 from ..observability.flightrec import record as _flight
@@ -81,6 +82,12 @@ REQUEUES = REGISTRY.counter(
     "farm_requeue_total",
     "Farm batches put back on the queue after a dispatch failure — "
     "the no-job-loss path", ("reason",))
+TENANT_CPU = REGISTRY.counter(
+    "farm_tenant_cpu_seconds_total",
+    "Solve wall time attributed per tenant: each coalesced batch's "
+    "dispatcher seconds split by the tenant's job share of the batch "
+    "(the farm half of the costStatus attribution plane; tenant ids "
+    "are bounded by the scheduler's registration cap)", ("tenant",))
 
 
 class FarmServer:
@@ -121,6 +128,12 @@ class FarmServer:
         self._server: asyncio.AbstractServer | None = None
         self._drain_task: asyncio.Task | None = None
         self._conn_ids = itertools.count(1)
+        #: dedicated NAMED dispatch thread (not the anonymous asyncio
+        #: default executor): the continuous profiler attributes farm
+        #: solve CPU to the "farm" thread class by this name prefix.
+        #: One worker — the drain loop awaits each batch anyway.
+        self._solve_exec = ThreadPoolExecutor(
+            1, thread_name_prefix="bmtpu-farm-solve")
         self._writers: dict[int, asyncio.StreamWriter] = {}
         #: every queued-or-inflight job by (initial_hash, target) —
         #: THE dedupe map the restart-adoption fix rides on
@@ -189,6 +202,7 @@ class FarmServer:
             except Exception as exc:
                 logger.debug("farm writer close failed: %r", exc)
         self._writers.clear()
+        self._solve_exec.shutdown(wait=False)
         CONNECTIONS.set(0)
 
     # -- journal plumbing ----------------------------------------------------
@@ -390,7 +404,8 @@ class FarmServer:
             try:
                 inject("farm.dispatch")
                 results = await loop.run_in_executor(
-                    None, lambda: self.solver.solve_batch(
+                    self._solve_exec,
+                    lambda: self.solver.solve_batch(
                         items, should_stop=self._shutdown.is_set,
                         start_nonces=starts, progress=progress))
             except asyncio.CancelledError:
@@ -409,6 +424,16 @@ class FarmServer:
             dt = max(time.monotonic() - t0, 1e-9)
             SOLVE_SECONDS.observe(dt)
             self.scheduler.note_drained(len(live), dt)
+            # cost attribution: the batch's solve seconds split by
+            # each tenant's job share — per-tenant CPU cost rides the
+            # registry (and the federation pushes) from here
+            tenant_jobs: dict[str, int] = {}
+            for job in live:
+                tenant_jobs[job.tenant] = \
+                    tenant_jobs.get(job.tenant, 0) + 1
+            for tenant, n in tenant_jobs.items():
+                TENANT_CPU.labels(tenant=tenant).inc(
+                    dt * n / len(live))
             now = time.monotonic()
             for job, res in zip(live, results):
                 nonce, trials = res

@@ -72,7 +72,7 @@ class StallGuard:
                 done.set()
 
         t = threading.Thread(target=worker, daemon=True,
-                             name="stall-guard-%s" % self.site)
+                             name="bmtpu-stall-%s" % self.site)
         t.start()
         if not done.wait(self.timeout):
             STALLS.labels(site=self.site).inc()

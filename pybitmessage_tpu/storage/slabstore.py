@@ -379,7 +379,7 @@ class SlabStore:
             self._drainer = threading.Thread(
                 target=_drainer_main,
                 args=(weakref.ref(self), self._drain_event),
-                name="slab-drain", daemon=True)
+                name="bmtpu-slab-drain", daemon=True)
             self._drainer.start()
         self._drain_event.set()
 
@@ -479,7 +479,7 @@ class SlabStore:
         self._sealing[key] = slab
         del self._open[bucket]
         t = threading.Thread(target=self._finalize_seal, args=(key,),
-                             name="slab-seal", daemon=True)
+                             name="bmtpu-slab-seal", daemon=True)
         self._seal_threads.add(t)
         t.start()
 

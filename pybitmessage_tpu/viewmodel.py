@@ -76,7 +76,7 @@ class EventPump:
     def start(self) -> "EventPump":
         import threading
         self._thread = threading.Thread(target=self._run, daemon=True,
-                                        name="bm-event-pump")
+                                        name="bmtpu-event-pump")
         self._thread.start()
         return self
 

@@ -132,6 +132,17 @@ GUARDS: list[tuple[str, str, float]] = [
     ("configs.ingest_storm.wide_host.objects_per_s", "higher", 0.60),
     ("configs.ingest_storm.wide_host.zero_objects_lost",
      "equal", 0.0),
+    # continuous profiling plane (ISSUE 15): the sampler's own cost
+    # must stay far under the 2% budget (absolute ceiling — the same
+    # bar make profile-smoke asserts), and the wide-host attribution
+    # snapshot must keep naming crypto/ECDH as a major CPU consumer
+    # (the PR 14 "ECDH-bound" finding as a standing invariant; full
+    # mode asserts outright dominance, the smoke floor absorbs the
+    # small-keyring noise)
+    ("configs.ingest_storm.attribution.sampler_overhead_frac",
+     "atmost", 0.02),
+    ("configs.ingest_storm.wide_host.attribution.crypto_share",
+     "atleast", 0.25),
     # sync: machine-independent bandwidth ratios + the loss invariant
     ("configs.sync_storm.announce_reduction_x", "higher", 0.30),
     ("configs.sync_storm.catchup_reduction_x", "higher", 0.30),

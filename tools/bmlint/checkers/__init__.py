@@ -7,6 +7,7 @@ from .blocking import BlockingCallChecker
 from .chaos import ResilienceChecker
 from .metricsconv import MetricsChecker
 from .swallow import SilentSwallowChecker
+from .threads import ThreadNamingChecker
 
 #: checker classes in report order
 CHECKERS = (
@@ -15,6 +16,7 @@ CHECKERS = (
     SilentSwallowChecker,
     MetricsChecker,
     ResilienceChecker,
+    ThreadNamingChecker,
 )
 
 #: every rule id any checker can emit (CLI validation, docs test)

@@ -1,0 +1,101 @@
+"""Checker: every package thread carries a ``bmtpu-`` descriptive name.
+
+The continuous profiling plane (``observability/profiling.py``)
+attributes CPU samples to thread CLASSES via thread-name prefixes —
+an anonymous ``Thread-7`` is unattributable, so named threads are a
+standing convention (ROADMAP), enforced here:
+
+- ``thread-naming`` — any ``threading.Thread(...)`` constructed inside
+  ``pybitmessage_tpu/`` must pass ``name=``, and a statically-visible
+  name (string literal, ``"..." % x`` format, f-string with a literal
+  head) must start with ``bmtpu-``.  Ditto ``ThreadPoolExecutor``'s
+  ``thread_name_prefix=``.  Fully dynamic names are accepted — the
+  rule is about the default-anonymous case, not about proving every
+  runtime string.
+
+``tools/`` and tests are exempt: only package runtime threads show up
+in a node's profiles.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import FileCtx, Finding, call_name, str_const
+
+_PREFIX = "bmtpu-"
+
+
+def _literal_head(node: ast.AST) -> str | None:
+    """The statically-known leading text of a name expression, or
+    None when nothing about its head is static."""
+    s = str_const(node)
+    if s is not None:
+        return s
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Mod):
+        return _literal_head(node.left)
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Add):
+        return _literal_head(node.left)
+    if isinstance(node, ast.JoinedStr) and node.values:
+        return _literal_head(node.values[0])
+    return None
+
+
+class ThreadNamingChecker:
+    name = "threads"
+    rules = ("thread-naming",)
+
+    def check_file(self, ctx: FileCtx):
+        out: list[Finding] = []
+        if not ctx.relpath.startswith("pybitmessage_tpu/"):
+            return out
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = call_name(node).rsplit(".", 1)[-1]
+            if callee == "Thread":
+                # Thread(group, target, name, ...) — name may arrive
+                # as the third positional argument
+                self._check(ctx, node, "name", 2,
+                            "threading.Thread", out)
+            elif callee == "ThreadPoolExecutor":
+                # ThreadPoolExecutor(max_workers, thread_name_prefix)
+                self._check(ctx, node, "thread_name_prefix", 1,
+                            "ThreadPoolExecutor", out)
+        return out
+
+    def finish(self):
+        return ()
+
+    def _check(self, ctx: FileCtx, node: ast.Call, kwarg: str,
+               pos: int, what: str, out: list[Finding]) -> None:
+        value = None
+        for kw in node.keywords:
+            if kw.arg == kwarg:
+                # an explicit name=None IS the anonymous case
+                if not (isinstance(kw.value, ast.Constant)
+                        and kw.value.value is None):
+                    value = kw.value
+                break
+        else:
+            if len(node.args) > pos:
+                arg = node.args[pos]
+                if not (isinstance(arg, ast.Constant)
+                        and arg.value is None):
+                    value = arg
+        if value is None:
+            out.append(ctx.finding(
+                "thread-naming", node,
+                "%s without %s= — anonymous threads are invisible to "
+                "the profiler's thread-class attribution; name it "
+                "'%s<subsystem>-...' (docs/observability.md)"
+                % (what, kwarg, _PREFIX)))
+            return
+        head = _literal_head(value)
+        if head is not None and not head.startswith(_PREFIX):
+            out.append(ctx.finding(
+                "thread-naming", node,
+                "%s %s=%r does not start with %r — the profiler's "
+                "thread-class map keys on that prefix "
+                "(docs/observability.md)" % (what, kwarg, head,
+                                             _PREFIX)))

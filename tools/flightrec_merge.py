@@ -74,9 +74,19 @@ def parse_dumps(text: str, *, source: str = "?") -> list[dict]:
 
 
 def _norm_dump(doc: dict, source: str) -> dict:
-    return {"node": str(doc.get("node") or source),
-            "skew": float(doc.get("skew") or 0.0),
-            "events": [e for e in doc["events"] if isinstance(e, dict)]}
+    out = {"node": str(doc.get("node") or source),
+           "skew": float(doc.get("skew") or 0.0),
+           "events": [e for e in doc["events"] if isinstance(e, dict)]}
+    # dumps from a profiler-wired node carry the stall window's
+    # stacks (observability/profiling.py).  A malformed block —
+    # wrong type, torn collapsed list — is SKIPPED, never fatal: the
+    # timeline merge must survive one crashed node's bad dump.
+    profile = doc.get("profile")
+    if isinstance(profile, dict) and \
+            isinstance(profile.get("collapsed"), list) and \
+            all(isinstance(s, str) for s in profile["collapsed"]):
+        out["profile"] = profile
+    return out
 
 
 def merge(dumps: list[dict]) -> list[dict]:
@@ -130,8 +140,20 @@ def main(argv=None) -> int:
             return 2
     events = merge(dumps)
     if args.as_json:
-        print(json.dumps({"nodes": sorted({d["node"] for d in dumps}),
-                          "events": events}, indent=2, default=repr))
+        out = {"nodes": sorted({d["node"] for d in dumps}),
+               "events": events}
+        # per-node stall-window profiles, when the dumps carried any
+        # (feed these straight into tools/profile_merge.py).  A LIST
+        # per node: a twice-stalled node's dumps each carry their own
+        # window, and last-wins would silently drop the first stall's
+        # stacks — the data a post-mortem exists for
+        profiles: dict[str, list] = {}
+        for d in dumps:
+            if "profile" in d:
+                profiles.setdefault(d["node"], []).append(d["profile"])
+        if profiles:
+            out["profiles"] = profiles
+        print(json.dumps(out, indent=2, default=repr))
     else:
         print(render_text(events))
     return 0

@@ -1,0 +1,226 @@
+#!/usr/bin/env python3
+"""Merge continuous-profiler dumps from many nodes into ONE profile.
+
+A role-split deployment (docs/roles.md) runs edges and relays as
+separate processes, each with its own continuous profiler
+(``observability/profiling.py``).  Answering "where does the FLEET's
+CPU go?" means folding their ``profileDump`` documents together —
+this tool is the profiling twin of ``tools/flightrec_merge.py``:
+
+    python tools/profile_merge.py edge1.json edge2.json relay.json
+    python tools/profile_merge.py --json dumps/*.json
+    python tools/profile_merge.py --speedscope out.json dumps/*.json
+
+Accepted inputs, auto-detected per file:
+
+- a ``profileDump`` / ``GET /debug/profile`` document
+  (``{"node", "collapsed": [...], ...}``);
+- a flight-recorder dump whose ``profile`` block carries a window
+  capture (``{"events": [...], "profile": {"collapsed": [...]}}``) —
+  so a stall post-mortem's dumps feed straight in;
+- a bare collapsed-stack array.
+
+Malformed profile blocks are SKIPPED with a warning, never fatal — a
+fleet merge must survive one crashed node's torn dump.
+
+Output: collapsed folded stacks with each stack prefixed by its node
+id (so per-node hot paths stay distinguishable inside one flamegraph),
+plus per-node and fleet-wide subsystem share tables; ``--json`` emits
+the same as one document, ``--speedscope OUT`` additionally writes a
+merged speedscope file with one profile per node.
+
+Like everything under ``tools/``, this script is swept by the bmlint
+gate (``make lint``, docs/static_analysis.md).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from collections import Counter
+
+
+def _valid_collapsed(block) -> list[str]:
+    """The well-formed folded lines of a candidate collapsed list
+    (``"a;b;c N"`` strings); [] for anything malformed."""
+    if not isinstance(block, list):
+        return []
+    out = []
+    for line in block:
+        if not isinstance(line, str):
+            continue
+        _stack, _, count = line.rpartition(" ")
+        try:
+            float(count)
+        except ValueError:
+            continue
+        out.append(line)
+    return out
+
+
+def parse_profile(text: str, *, source: str = "?") -> dict | None:
+    """One ``{"node", "collapsed", "by_subsystem"}`` dict from a dump
+    file, or None when the file carries no usable profile block."""
+    try:
+        doc = json.loads(text)
+    except json.JSONDecodeError:
+        return None
+    if isinstance(doc, list):
+        doc = {"collapsed": doc}
+    if not isinstance(doc, dict):
+        return None
+    # flight-recorder dump shape: the capture rides in "profile"
+    if "collapsed" not in doc and isinstance(doc.get("profile"), dict):
+        inner = doc["profile"]
+        doc = {"node": doc.get("node"),
+               "collapsed": inner.get("collapsed"),
+               "by_subsystem": inner.get("by_subsystem")}
+    collapsed = _valid_collapsed(doc.get("collapsed"))
+    if not collapsed:
+        return None
+    by_sub = doc.get("by_subsystem")
+    return {"node": str(doc.get("node") or source),
+            "collapsed": collapsed,
+            "by_subsystem": by_sub if isinstance(by_sub, dict) else {}}
+
+
+def merge(profiles: list[dict]) -> dict:
+    """Fold per-node profiles into one document: node-prefixed
+    collapsed stacks, per-node subsystem shares, and the fleet-wide
+    subsystem share table (idle excluded from shares)."""
+    collapsed: Counter = Counter()
+    fleet_sub: Counter = Counter()
+    # accumulate per node FIRST: two dumps from the same node id
+    # (e.g. two stall captures) must sum, exactly like the collapsed
+    # stacks and fleet totals do — assigning shares per input file
+    # would keep only the last file's view
+    node_sub: dict[str, Counter] = {}
+    for prof in profiles:
+        node = prof["node"]
+        for line in prof["collapsed"]:
+            stack, _, count = line.rpartition(" ")
+            collapsed["%s;%s" % (node, stack)] += float(count)
+        subs = {str(k): float(v)
+                for k, v in prof["by_subsystem"].items()
+                if isinstance(v, (int, float))}
+        fleet_sub.update(subs)
+        node_sub.setdefault(node, Counter()).update(subs)
+    per_node: dict[str, dict] = {}
+    for node, subs in node_sub.items():
+        live = {k: v for k, v in subs.items() if k != "idle"}
+        total = sum(live.values())
+        per_node[node] = {
+            k: round(v / total, 4) for k, v in sorted(live.items())
+        } if total else {}
+    live = {k: v for k, v in fleet_sub.items() if k != "idle"}
+    total = sum(live.values())
+    return {
+        "nodes": sorted({p["node"] for p in profiles}),
+        # fractional weights (re-merges of --speedscope output,
+        # weighted profilers) must survive: %d would truncate a
+        # 0.9-weight stack to zero and silently drop it
+        "collapsed": ["%s %s" % (k, int(v) if float(v).is_integer()
+                                 else repr(float(v)))
+                      for k, v in sorted(collapsed.items())],
+        "subsystem_shares": {
+            k: round(v / total, 4) for k, v in sorted(live.items())
+        } if total else {},
+        "per_node_shares": per_node,
+    }
+
+
+def merged_speedscope(profiles: list[dict]) -> dict:
+    """One speedscope document with one ``sampled`` profile per node,
+    all referencing ONE shared frame table (speedscope's multi-profile
+    contract — per-node indices into separate tables would render
+    garbage)."""
+    frames: list[dict] = []
+    index: dict[str, int] = {}
+
+    def frame_of(name: str) -> int:
+        i = index.get(name)
+        if i is None:
+            i = index[name] = len(frames)
+            frames.append({"name": name})
+        return i
+
+    out_profiles = []
+    for prof in profiles:
+        samples, weights = [], []
+        for line in prof["collapsed"]:
+            stack, _, count = line.rpartition(" ")
+            samples.append([frame_of(part)
+                            for part in stack.split(";") if part])
+            weights.append(float(count))
+        out_profiles.append({
+            "type": "sampled", "name": prof["node"], "unit": "none",
+            "startValue": 0, "endValue": sum(weights),
+            "samples": samples, "weights": weights,
+        })
+    return {
+        "$schema": "https://www.speedscope.app/file-format-schema.json",
+        "exporter": "pybitmessage-tpu profile_merge",
+        "name": "fleet",
+        "activeProfileIndex": 0,
+        "shared": {"frames": frames},
+        "profiles": out_profiles,
+    }
+
+
+def render_text(merged: dict) -> str:
+    lines = ["# %d node(s): %s" % (len(merged["nodes"]),
+                                   ", ".join(merged["nodes"]))]
+    if merged["subsystem_shares"]:
+        lines.append("# fleet CPU shares (idle excluded):")
+        for sub, share in sorted(merged["subsystem_shares"].items(),
+                                 key=lambda kv: -kv[1]):
+            lines.append("#   %-14s %5.1f%%" % (sub, share * 100))
+    lines.extend(merged["collapsed"])
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("files", nargs="+",
+                    help="profileDump JSON files (or flight-recorder "
+                         "dumps carrying profile blocks)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit the merged document as JSON")
+    ap.add_argument("--speedscope", metavar="OUT", default=None,
+                    help="also write a merged speedscope file (one "
+                         "profile per node)")
+    args = ap.parse_args(argv)
+
+    profiles = []
+    for path in args.files:
+        try:
+            with open(path) as f:
+                prof = parse_profile(f.read(), source=path)
+        except OSError as exc:
+            sys.stderr.write("profile_merge: %s\n" % exc)
+            return 2
+        if prof is None:
+            # skipped, not fatal: one torn dump must not kill the
+            # fleet merge
+            sys.stderr.write("profile_merge: %s: no usable profile "
+                             "block; skipped\n" % path)
+            continue
+        profiles.append(prof)
+    if not profiles:
+        sys.stderr.write("profile_merge: no usable profiles\n")
+        return 2
+    merged = merge(profiles)
+    if args.speedscope:
+        with open(args.speedscope, "w") as f:
+            json.dump(merged_speedscope(profiles), f)
+        sys.stderr.write("profile_merge: wrote %s\n" % args.speedscope)
+    if args.as_json:
+        print(json.dumps(merged, indent=2))
+    else:
+        print(render_text(merged))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
