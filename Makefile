@@ -3,7 +3,7 @@
 # pybitmessage_tpu/pow/native.py when missing or stale.
 
 .PHONY: all native test bench bench-smoke chaos perfguard lint \
-	roles-smoke profile-smoke clean
+	roles-smoke profile-smoke device-smoke doctor clean
 
 all: native
 
@@ -62,6 +62,25 @@ perfguard:
 profile-smoke:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_profiling.py \
 		-q -m 'not slow'
+
+# device-telemetry smoke (docs/observability.md "Device telemetry"):
+# the per-program compile/launch/transfer attribution must populate on
+# the CPU backend — compile-vs-cache split, double-buffer busy union,
+# deviceStatus / costStatus.device / GET /debug/device end to end,
+# doctor diagnosis golden, <2% overhead.  CI-runnable, no TPU.
+device-smoke:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_devicetelemetry.py \
+		-q -m 'not slow'
+
+# TPU preflight doctor (docs/observability.md): fingerprint the
+# jax/jaxlib/libtpu stack, enumerate devices, compile-probe every
+# program in the device-telemetry catalog, and map known failure
+# signatures (libtpu version mismatch, device busy, OOM) to named
+# diagnoses.  Nonzero exit blocks a multi-chip rendezvous (ROADMAP
+# item 3); classify a recorded failure tail with:
+#   python tools/tpu_doctor.py --diagnose MULTICHIP_r01.json
+doctor:
+	python tools/tpu_doctor.py
 
 # role-split smoke (docs/roles.md): spawn edge+relay as REAL daemon
 # subprocesses, deliver one message end to end over TCP through the

@@ -35,7 +35,8 @@ import time
 from contextlib import contextmanager
 
 from pybitmessage_tpu.observability import (REGISTRY, enable_jax_annotations,
-                                            snapshot, trace)
+                                            env_fingerprint, snapshot,
+                                            trace)
 
 LANES = 1 << 19
 CHUNKS = 64
@@ -866,6 +867,54 @@ def _bench_tpu_vs_native(drain: int = 256, sample: int = 8) -> dict:
         crypto_tpu.reset_tpu()
 
 
+def _bench_device_telemetry(reps: int = 5, batch: int = 64) -> dict:
+    """Device-telemetry plane cost + zero-loss (ISSUE 16).
+
+    The PR 1 harness shape: repeated batched device launches (the
+    ``pow_verify`` program) with the always-on telemetry recording
+    each one.  ``overhead_frac`` is the measured per-``record_launch``
+    cost (timed over a scratch program so the real counters stay
+    honest) amortized over the harness wall — the same <2% budget the
+    tracing and sampler planes are held to.  ``populated_zero_loss``
+    is 1 only when every launch the harness issued landed in the
+    registry and nothing fell into ``device_telemetry_dropped_total``.
+    """
+    from pybitmessage_tpu.observability.devicetelemetry import \
+        record_launch
+    from pybitmessage_tpu.ops import pow_search
+
+    ih = hashlib.sha512(b"telemetry overhead harness").digest()
+    items = [(i, ih, (1 << 64) - 1) for i in range(batch)]
+    before = REGISTRY.sample("device_launches_total",
+                             {"program": "pow_verify"})
+    dropped0 = REGISTRY.sample("device_telemetry_dropped_total")
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        pow_search.verify(items)
+    wall = max(time.perf_counter() - t0, 1e-9)
+    launches = REGISTRY.sample("device_launches_total",
+                               {"program": "pow_verify"}) - before
+    dropped = REGISTRY.sample("device_telemetry_dropped_total") - dropped0
+    # per-record cost, timed in isolation on a scratch program (its
+    # series ride /metrics but stay out of deviceStatus, which walks
+    # only registered programs)
+    calls = 2000
+    t0 = time.perf_counter()
+    for i in range(calls):
+        record_launch("bench_overhead_probe", key=batch,
+                      dispatch_seconds=1e-4, wait_seconds=1e-4,
+                      span=(float(i), float(i) + 1e-3), items=batch,
+                      bytes_in=1024, bytes_out=64)
+    per_record = (time.perf_counter() - t0) / calls
+    return {
+        "launches": int(launches),
+        "dropped": int(dropped),
+        "record_us": round(per_record * 1e6, 2),
+        "overhead_frac": round(per_record * reps / wall, 6),
+        "populated_zero_loss": int(launches >= reps and dropped == 0),
+    }
+
+
 def _bench_ingest_storm(identities: int = 8, objects: int = 400,
                         smoke: bool = False) -> dict:
     """Ingest fast path end-to-end: a multi-identity flood mix (msgs
@@ -1205,6 +1254,9 @@ def _bench_ingest_storm(identities: int = 8, objects: int = 400,
         "objects": objects, "identities": identities,
         "mix": {"for_us": for_us, "foreign": objects - for_us},
         "pipelined": pipe, "inline_baseline": inline,
+        # device-telemetry plane cost + zero-loss on the PR 1 harness
+        # shape (ISSUE 16; perfguard-banded like the sampler above)
+        "device_telemetry": _bench_device_telemetry(),
         # socket -> batch crypto -> slab store, end to end (ISSUE 12
         # satellite; ROADMAP item 3 remnant)
         "end_to_end_slab": e2e_slab,
@@ -2673,6 +2725,9 @@ def _smoke_main() -> int:
         "vs_baseline": round(device / host, 2),
         "kernel": "xla-smoke",
         "smoke": True,
+        # self-describing run: jax/jaxlib/libtpu versions + device
+        # identity, so a BENCH JSON is comparable across environments
+        "env": env_fingerprint(),
         "baselines": {"python_hashlib_1core_hps": round(host, 1)},
         "configs": configs,
         "metrics_snapshot": snapshot(),
@@ -2815,6 +2870,10 @@ def main():
         "u32_ops_per_sec": round(device * OPS_PER_TRIAL, 0),
         "mfu": (mfu_info or {}).get("mfu"),
         "mfu_detail": mfu_info,
+        # self-describing run: jax/jaxlib/libtpu versions + device
+        # identity, so BENCH/MULTICHIP JSONs are comparable across
+        # environments (the doctor leads its report with the same)
+        "env": env_fingerprint(),
         "baselines": {
             "python_hashlib_1core_hps": round(host, 1),
             "cpp_pthreads_allcores_hps": round(native, 1),

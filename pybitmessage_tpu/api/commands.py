@@ -816,6 +816,32 @@ class CommandHandler:
                 win, speedscope=fmt != "collapsed",
                 node_id=node_id), default=repr))
 
+    def cmd_deviceStatus(self):
+        """Device telemetry plane (docs/observability.md "Device
+        telemetry"): the per-jitted-program attribution table —
+        compiles vs cache hits, launches, dispatch vs on-device
+        execute-wait seconds, host<->device bytes and donation rate,
+        derived hashrate and MFU — plus per-device identity/memory
+        gauges and the jax/jaxlib/libtpu environment fingerprint.
+        The same document is served as ``GET /debug/device``."""
+        from ..observability import device_status
+        return json.dumps(device_status(), indent=4)
+
+    async def cmd_profileDevice(self, seconds=1):
+        """Capture an on-demand ``jax.profiler`` device trace for
+        ``seconds`` (default 1, max 60) and return the trace directory
+        plus the files written — load it in TensorBoard/XProf for
+        per-kernel device timelines.  Blocking capture runs off the
+        event loop.  Also reachable as ``GET /debug/device?seconds=N``."""
+        try:
+            seconds = float(seconds)
+        except (TypeError, ValueError):
+            raise APIError(0, "seconds must be numeric")
+        from ..observability import capture_device_trace
+        return await asyncio.get_running_loop().run_in_executor(
+            None, lambda: json.dumps(
+                capture_device_trace(seconds), default=repr))
+
     def cmd_objectTimeline(self, hash_hex):
         """Lifecycle timeline of one inventory hash: the recorded
         stage events (received/parsed/decrypted/verified/stored/
@@ -980,6 +1006,22 @@ class CommandHandler:
             out["client"] = client.snapshot()
         return out
 
+    def _device_stats(self) -> dict:
+        """Compact device-telemetry block for clientStatus: per-program
+        launch/compile counts and derived rates (programs that never
+        launched are elided — the full table lives in deviceStatus)."""
+        from ..observability import device_status
+        st = device_status()
+        progs = {name: {"launches": row["launches"],
+                        "compiles": row["compiles"],
+                        "cacheHits": row["cacheHits"],
+                        "hashrateHps": row["hashrateHps"],
+                        "mfu": row["mfu"]}
+                 for name, row in st["programs"].items()
+                 if row["launches"]}
+        return {"programs": progs, "env": st["env"],
+                "dropped": st["dropped"]}
+
     def cmd_farmStatus(self):
         """Full PoW solver-farm status: scheduler snapshot (per-lane
         depths, projected waits, per-tenant queued/solved/weights),
@@ -1047,6 +1089,10 @@ class CommandHandler:
             # PoW solver farm: daemon scheduler/tenants + client tier
             # (docs/pow_farm.md)
             "farm": self._farm_stats(),
+            # device telemetry: per-jitted-program launch/compile
+            # attribution + environment fingerprint (docs/
+            # observability.md "Device telemetry")
+            "device": self._device_stats(),
             # composite per-subsystem health verdicts + loop lag
             # (ISSUE 6; observability/health.py)
             "health": self._health_stats(),

@@ -170,6 +170,46 @@ class APIServer:
                     await self._respond_raw(writer, 200, body_bytes,
                                             "application/json")
                     return
+                if path == "/debug/device":
+                    # the device-telemetry plane (docs/observability.md
+                    # "Device telemetry"): the per-program attribution
+                    # table by default; ?seconds=N instead captures an
+                    # on-demand jax.profiler device trace for N seconds
+                    # and returns the trace directory.
+                    if not self._authorized(headers):
+                        await self._respond(
+                            writer, 401, {"error": "unauthorized"},
+                            extra="WWW-Authenticate: Basic\r\n")
+                        return
+                    seconds = None
+                    for part in query.split("&"):
+                        k, _, v = part.partition("=")
+                        if k == "seconds":
+                            try:
+                                seconds = float(v)
+                            except ValueError:
+                                await self._respond(
+                                    writer, 400,
+                                    {"error": "bad seconds"})
+                                return
+                    from ..observability import (capture_device_trace,
+                                                 device_status)
+                    # both the status walk (jax.devices + memory_stats)
+                    # and a trace capture block: executor, not the
+                    # event loop
+                    if seconds and seconds > 0:
+                        work = (lambda: json.dumps(
+                            capture_device_trace(seconds),
+                            default=repr).encode("utf-8"))
+                    else:
+                        work = (lambda: json.dumps(
+                            device_status(),
+                            default=repr).encode("utf-8"))
+                    body_bytes = await asyncio.get_running_loop() \
+                        .run_in_executor(None, work)
+                    await self._respond_raw(writer, 200, body_bytes,
+                                            "application/json")
+                    return
                 if path in ("/metrics", "/metrics/federated"):
                     if not self._authorized(headers):
                         await self._respond(

@@ -43,6 +43,9 @@ from __future__ import annotations
 import logging
 import os
 import threading
+import time
+
+from ..observability.devicetelemetry import record_launch
 
 logger = logging.getLogger("pybitmessage_tpu.crypto")
 
@@ -259,31 +262,52 @@ class TpuSecp:
     def _verify_lanes(self, ops, args) -> list[bool]:
         import numpy as np
         n = args[0].shape[-1]
-        padded = [ops.pad_lanes(a, self._lane_count(ops, n))
-                  for a in args]
+        lanes = self._lane_count(ops, n)
+        padded = [ops.pad_lanes(a, lanes) for a in args]
+        bytes_in = sum(int(a.nbytes) for a in padded)
+        t0 = time.monotonic()
         if self._use_pallas:
             tiled = [a.reshape(a.shape[0], -1, ops.LANE_ROWS,
                                ops.LANE_COLS) for a in padded]
-            ok = np.asarray(ops.pallas_verify(*tiled)).reshape(-1)
+            ok_dev = ops.pallas_verify(*tiled)
+            t1 = time.monotonic()
+            ok = np.asarray(ok_dev).reshape(-1)
         else:
-            ok = np.asarray(ops.xla_verify(*padded))
+            ok_dev = ops.xla_verify(*padded)
+            t1 = time.monotonic()
+            ok = np.asarray(ok_dev)
+        t2 = time.monotonic()
+        record_launch("secp_verify",
+                      key=(lanes, self._use_pallas),
+                      dispatch_seconds=t1 - t0, wait_seconds=t2 - t1,
+                      span=(t0, t2), items=n, bytes_in=bytes_in,
+                      bytes_out=int(ok.nbytes))
         return [bool(ok[i]) for i in range(n)]
 
     def _ecdh_lanes(self, ops, args, *, want_y: bool = False):
         import numpy as np
         n = args[0].shape[-1]
-        padded = [ops.pad_lanes(a, self._lane_count(ops, n))
-                  for a in args]
+        lanes = self._lane_count(ops, n)
+        padded = [ops.pad_lanes(a, lanes) for a in args]
+        bytes_in = sum(int(a.nbytes) for a in padded)
+        t0 = time.monotonic()
         if self._use_pallas:
             tiled = [a.reshape(a.shape[0], -1, ops.LANE_ROWS,
                                ops.LANE_COLS) for a in padded]
             x, y, ok = ops.pallas_ecdh(*tiled)
+            t1 = time.monotonic()
             x = np.asarray(x).reshape(ops.LIMBS, -1)
             y = np.asarray(y).reshape(ops.LIMBS, -1)
             ok = np.asarray(ok).reshape(-1)
         else:
             x, y, ok = ops.xla_ecdh(*padded)
+            t1 = time.monotonic()
             x, y, ok = np.asarray(x), np.asarray(y), np.asarray(ok)
+        t2 = time.monotonic()
+        record_launch("secp_ecdh", key=(lanes, self._use_pallas),
+                      dispatch_seconds=t1 - t0, wait_seconds=t2 - t1,
+                      span=(t0, t2), items=n, bytes_in=bytes_in,
+                      bytes_out=int(x.nbytes + y.nbytes + ok.nbytes))
         xs = ops.limbs_to_bytes(x[:, :n])
         if want_y:
             ys = ops.limbs_to_bytes(y[:, :n])

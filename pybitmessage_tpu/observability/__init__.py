@@ -4,6 +4,10 @@ object lifecycle tracing, flight recorder, runtime health probes.
 See docs/observability.md for the full catalog of exported metrics.
 """
 
+from .devicetelemetry import (DEVICE_TELEMETRY, DeviceTelemetry,
+                              capture_device_trace, device_cost_block,
+                              device_status, env_fingerprint,
+                              record_launch, register_program)
 from .export import (escape_help, escape_label_value, log_snapshot_task,
                      render_prometheus, snapshot)
 from .federation import (FEDERATION_VERSION, Aggregator,
@@ -35,6 +39,9 @@ __all__ = [
     "FlightRecorder", "FLIGHT_RECORDER",
     "HealthMonitor", "LoopLagProbe",
     "SamplingProfiler", "PROFILER", "cost_status",
+    "DeviceTelemetry", "DEVICE_TELEMETRY", "register_program",
+    "record_launch", "device_status", "device_cost_block",
+    "capture_device_trace", "env_fingerprint",
     "Aggregator", "FederationPublisher", "FEDERATION_VERSION",
     "http_transport", "mergeable_snapshot",
 ]

@@ -1,0 +1,590 @@
+"""Device telemetry plane: compile/launch/transfer attribution and
+MFU accounting for every jitted/Pallas program (ISSUE 16).
+
+All five observability layers shipped so far see only the *host* —
+device time was a black box.  This module is the accelerator-side
+instrument panel: a process-wide :class:`DeviceTelemetry` registry
+that every launch site in ops/, parallel/, crypto/ and pow/ routes
+through (the bmlint ``devicelaunch`` checker enforces the routing).
+Per named program it attributes:
+
+- **compiles vs cache hits** — the first launch of a (program,
+  static-shape key) traces + compiles synchronously inside the
+  dispatch call, so its dispatch wall clock IS the compile time;
+  subsequent same-key launches are cache hits.  The split makes a
+  recompile storm (an unstable static argument) visible as a counter
+  instead of a mystery slowdown.
+- **dispatch vs execute wait** — host seconds spent issuing the
+  launch vs blocking on the device->host fetch
+  (``block_until_ready``/``np.asarray`` bracketing).
+- **device-busy seconds, double-buffer aware** — each launch
+  contributes its (dispatch_start, fetch_end) span to a per-program
+  union-of-intervals watermark, so two overlapping in-flight slabs
+  credit the overlap ONCE (a naive sum would report >100% busy).
+- **host<->device bytes and donation hit-rate** — upload/readback
+  volume per program plus bytes moved through ``donate_argnums``
+  buffers (the packed kernel donates bases/targets).
+- **derived rates** — ``device_hashrate_hps`` (EWMA work items per
+  busy second) and ``device_mfu_ratio`` against the documented
+  flops-per-item model below.
+
+Everything lands in ``observability.REGISTRY`` with bounded labels,
+so it rides ``GET /metrics``, federation pushes, costStatus (its
+"device" block), clientStatus/deviceStatus, and the flight
+recorder's stall dumps for free.  On-demand ``jax.profiler`` device
+traces are served behind ``profileDevice [seconds]`` and
+``GET /debug/device?seconds=N`` via :func:`capture_device_trace`.
+
+Flops-per-item model (documented estimates, BASELINE.md "Arithmetic
+utilization"): one double-SHA512 PoW trial executes
+:data:`POW_FLOPS_PER_HASH` = 21152 vector u32 ops (counted from the
+jaxpr of the unrolled schedule); one ECDSA verify is ~3.6e6 u32 ops
+(Strauss-Shamir 256-step double ladder over 20x13-bit limbs), one
+ECDH ~2.4e6 (single 256-step Montgomery-style ladder).  Peak is
+:data:`DEVICE_PEAK_OPS` = 6.1e12 u32/s per v5e chip (8x128 lanes x 4
+ALUs x ~1.5 GHz) — on a CPU backend the MFU gauge is honest but tiny.
+
+Program catalog (lockstep with the ``devicelaunch`` checker: every
+row below must be ``register_program()``-ed by a launch module, and
+every registration must have a row here):
+
+``pow_slab`` — XLA windowed single-chip nonce search
+  (``ops/pow_search.pow_search_jit`` under the ``solve`` host driver).
+``pow_verify`` — batched incoming-object PoW verification
+  (``ops/pow_search.pow_verify_batch``).
+``pallas_slab`` — Mosaic single-object slab kernel
+  (``ops/sha512_pallas.pallas_search`` under ``solve``).
+``batch_search`` — per-object batch kernel
+  (``ops/sha512_pallas.pallas_batch_search``; also the pipeline's
+  batched mode).
+``packed_search`` — packed multi-object Mosaic kernel, the storm
+  path (``ops/sha512_pallas.pallas_packed_search``).
+``packed_search_xla`` — XLA stand-in of the packed kernel
+  (``pow/pipeline._packed_search_xla``; the CPU-CI pipeline path).
+``sharded_search`` — pod-wide XLA windowed search with psum
+  early-exit (``parallel/pow_sharded.sharded_solve``).
+``sharded_batch`` — pod-wide XLA batch search over a 2D mesh
+  (``parallel/pow_sharded.sharded_solve_batch``).
+``pod_slab`` — pod-wide Pallas single-object slab
+  (``parallel/pow_pallas_sharded.pallas_sharded_solve``).
+``pod_batch`` — pod-wide Pallas batch
+  (``parallel/pow_pallas_sharded.pallas_sharded_solve_batch``).
+``secp_verify`` — batch ECDSA acceptance lanes
+  (``ops/secp256k1_pallas`` via ``crypto/tpu.TpuSecp``).
+``secp_ecdh`` — batch ECDH / fixed-base-mult lanes
+  (``ops/secp256k1_pallas`` via ``crypto/tpu.TpuSecp``).
+
+JAX is never imported at module import (the lazy-probe rule
+``crypto/tpu.py`` set): device/memory enumeration peeks at the
+already-imported module and degrades to empty on hosts where JAX was
+never initialized.  Recording never raises into a launch path — a
+failed
+update counts into ``device_telemetry_dropped_total`` instead.
+
+See docs/observability.md ("Device telemetry") for the metric
+catalog and runbook.
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+import threading
+import time
+
+from .metrics import REGISTRY
+
+logger = logging.getLogger("pybitmessage_tpu.observability")
+
+#: vector u32 ops per double-SHA512 trial, counted from the jaxpr of
+#: the unrolled schedule the kernel executes (BASELINE.md)
+POW_FLOPS_PER_HASH = 21152.0
+#: ~order-of-magnitude u32 ops per batch ECDSA verify: Strauss-Shamir
+#: 256-step double ladder, ~7 field mults/step x ~400 limb ops x 2
+#: points + inversions (documented model, not a measurement)
+SECP_VERIFY_FLOPS = 3.6e6
+#: one 256-step scalar-mult ladder (ECDH / fixed-base)
+SECP_ECDH_FLOPS = 2.4e6
+#: v5e VPU peak u32 issue rate per chip (8x128 lanes x 4 ALUs x
+#: ~1.5 GHz) — the documented denominator of every MFU figure
+DEVICE_PEAK_OPS = 6.1e12
+
+#: bound on remembered (program, static-key) compile-cache entries —
+#: a runaway dynamic key degrades to counting everything as a compile
+#: rather than growing without bound
+MAX_COMPILE_KEYS = 4096
+#: EWMA smoothing for the derived hashrate gauge
+RATE_ALPHA = 0.3
+
+#: bounded per-device label values ("d00".."d15", then "overflow") —
+#: raw ``str(i)`` label values are exactly what the metric-labels
+#: lint exists to stop
+_MAX_DEVICE_LABELS = 16
+_DEVICE_LABELS = tuple("d%02d" % i for i in range(_MAX_DEVICE_LABELS)
+                       ) + ("overflow",)
+
+
+def _device_label(index: int) -> str:
+    return _DEVICE_LABELS[min(int(index), _MAX_DEVICE_LABELS)]
+
+
+COMPILES = REGISTRY.counter(
+    "device_program_compiles_total",
+    "First-call traces+compiles per named device program (a new "
+    "(program, static-shape key) pairing)", ("program",))
+CACHE_HITS = REGISTRY.counter(
+    "device_program_cache_hits_total",
+    "Launches that reused an already-compiled executable",
+    ("program",))
+COMPILE_SECONDS = REGISTRY.histogram(
+    "device_program_compile_seconds",
+    "Dispatch wall seconds of first-key launches (trace+compile "
+    "happens synchronously inside that dispatch)", ("program",))
+LAUNCHES = REGISTRY.counter(
+    "device_launches_total",
+    "Device program launches by program name", ("program",))
+DISPATCH_SECONDS = REGISTRY.histogram(
+    "device_dispatch_seconds",
+    "Host seconds spent issuing one launch (async dispatch call, "
+    "excludes the blocking fetch)", ("program",))
+EXECUTE_WAIT_SECONDS = REGISTRY.histogram(
+    "device_execute_wait_seconds",
+    "Host seconds blocked on the device->host fetch of one launch "
+    "(the on-device execute proxy under double buffering)",
+    ("program",))
+BUSY_SECONDS = REGISTRY.counter(
+    "device_busy_seconds_total",
+    "Union-of-spans device-busy seconds per program: overlapping "
+    "double-buffered launches credit their overlap once",
+    ("program",))
+H2D_BYTES = REGISTRY.counter(
+    "device_h2d_bytes_total",
+    "Host->device bytes uploaded as launch operands", ("program",))
+D2H_BYTES = REGISTRY.counter(
+    "device_d2h_bytes_total",
+    "Device->host bytes fetched as launch results", ("program",))
+DONATED_BYTES = REGISTRY.counter(
+    "device_donated_bytes_total",
+    "Uploaded bytes whose device buffer was donated back "
+    "(donate_argnums — the donation hit-rate numerator over "
+    "device_h2d_bytes_total)", ("program",))
+WORK_ITEMS = REGISTRY.counter(
+    "device_work_items_total",
+    "Work items (PoW trial hashes, crypto lane items) executed per "
+    "program — the hashrate/MFU numerator", ("program",))
+HASHRATE = REGISTRY.gauge(
+    "device_hashrate_hps",
+    "EWMA work items per second per program, from launch spans and "
+    "the kernel's known items-per-launch", ("program",))
+MFU = REGISTRY.gauge(
+    "device_mfu_ratio",
+    "Model flops utilization: hashrate x documented flops-per-item "
+    "over the device peak (DEVICE_PEAK_OPS x devices)", ("program",))
+DEVICE_MEMORY = REGISTRY.gauge(
+    "device_memory_bytes",
+    "Live device memory where the backend exposes memory_stats() "
+    "(bytes_in_use / bytes_limit per bounded device label)",
+    ("device", "kind"))
+DEVICE_INFO = REGISTRY.gauge(
+    "device_backend_info",
+    "Device count by backend platform and device kind (a presence/"
+    "topology gauge for federation panes)", ("platform", "kind"))
+TELEMETRY_DROPPED = REGISTRY.counter(
+    "device_telemetry_dropped_total",
+    "record_launch updates that raised and were dropped (telemetry "
+    "must never fail the launch path it observes)")
+
+
+class DeviceTelemetry:
+    """Process-wide device-program registry + launch recorder.
+
+    ``register_program`` is called at import time by each launch
+    module with a LITERAL program name (the ``devicelaunch`` checker
+    reads those literals for the catalog lockstep); ``record_launch``
+    is called per launch from host drivers and never raises.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._programs: dict[str, dict] = {}
+        self._seen_keys: set[tuple] = set()
+        #: per-program busy-span watermark (monotonic end time of the
+        #: union of all credited spans) — spans complete in fetch
+        #: order, so a watermark is an exact union-of-intervals
+        self._busy_end: dict[str, float] = {}
+        self._rate: dict[str, float] = {}
+
+    # -- registration --------------------------------------------------------
+
+    def register_program(self, name: str, *,
+                         flops_per_item: float | None = None,
+                         module: str = "") -> None:
+        """Declare a named device program (idempotent).
+
+        ``flops_per_item`` feeds the MFU model; ``module`` is the
+        defining module for the deviceStatus table."""
+        with self._lock:
+            spec = self._programs.setdefault(
+                name, {"flops_per_item": None, "module": ""})
+            if flops_per_item is not None:
+                spec["flops_per_item"] = float(flops_per_item)
+            if module:
+                spec["module"] = module
+
+    def programs(self) -> dict[str, dict]:
+        with self._lock:
+            return {k: dict(v) for k, v in self._programs.items()}
+
+    # -- recording -----------------------------------------------------------
+
+    def record_launch(self, program: str, *, key=None,
+                      dispatch_seconds: float = 0.0,
+                      wait_seconds: float = 0.0,
+                      span: tuple[float, float] | None = None,
+                      items: float = 0, bytes_in: int = 0,
+                      bytes_out: int = 0, bytes_donated: int = 0,
+                      devices: int = 1) -> None:
+        """Attribute one finished launch.  Never raises.
+
+        ``key`` is the program's static-shape tuple: its first
+        sighting is counted as a compile (with ``dispatch_seconds``
+        as the compile wall), later sightings as cache hits.
+        ``span`` is (dispatch_start, fetch_end) in ``time.monotonic``
+        terms; overlap with the previous span is credited once.
+        """
+        try:
+            self._record(program, key, float(dispatch_seconds),
+                         float(wait_seconds), span, float(items),
+                         int(bytes_in), int(bytes_out),
+                         int(bytes_donated), max(1, int(devices)))
+        except Exception:
+            try:
+                TELEMETRY_DROPPED.inc()
+            # a broken registry must still not raise into the launch
+            # path — the debug log below is the only trace
+            except Exception:  # bmlint: allow(silent-swallow)
+                pass  # pragma: no cover — last resort
+            logger.debug("device telemetry update dropped",
+                         exc_info=True)
+
+    def _record(self, program, key, dispatch_seconds, wait_seconds,
+                span, items, bytes_in, bytes_out, bytes_donated,
+                devices):
+        LAUNCHES.labels(program=program).inc()
+        DISPATCH_SECONDS.labels(program=program).observe(
+            dispatch_seconds)
+        EXECUTE_WAIT_SECONDS.labels(program=program).observe(
+            wait_seconds)
+        if bytes_in:
+            H2D_BYTES.labels(program=program).inc(bytes_in)
+        if bytes_out:
+            D2H_BYTES.labels(program=program).inc(bytes_out)
+        if bytes_donated:
+            DONATED_BYTES.labels(program=program).inc(bytes_donated)
+        if items:
+            WORK_ITEMS.labels(program=program).inc(items)
+
+        if key is not None:
+            compile_key = (program, key)
+            with self._lock:
+                new = compile_key not in self._seen_keys
+                if new and len(self._seen_keys) < MAX_COMPILE_KEYS:
+                    self._seen_keys.add(compile_key)
+            if new:
+                COMPILES.labels(program=program).inc()
+                COMPILE_SECONDS.labels(program=program).observe(
+                    dispatch_seconds)
+            else:
+                CACHE_HITS.labels(program=program).inc()
+
+        if span is None:
+            busy = dispatch_seconds + wait_seconds
+        else:
+            start, end = float(span[0]), float(span[1])
+            with self._lock:
+                watermark = self._busy_end.get(program, start)
+                busy = max(0.0, end - max(start, watermark))
+                self._busy_end[program] = max(watermark, end)
+        if busy > 0:
+            BUSY_SECONDS.labels(program=program).inc(busy)
+
+        if items and busy > 0:
+            inst = items / busy
+            with self._lock:
+                prev = self._rate.get(program)
+                rate = inst if prev is None else (
+                    prev + RATE_ALPHA * (inst - prev))
+                self._rate[program] = rate
+                flops = self._programs.get(program, {}).get(
+                    "flops_per_item")
+            HASHRATE.labels(program=program).set(rate)
+            if flops:
+                MFU.labels(program=program).set(
+                    min(rate * flops / (DEVICE_PEAK_OPS * devices),
+                        1.0))
+
+    def reset(self) -> None:
+        """Drop compile-cache/busy state (tests; counters stay
+        monotonic as the registry requires)."""
+        with self._lock:
+            self._seen_keys.clear()
+            self._busy_end.clear()
+            self._rate.clear()
+
+
+#: the process-wide registry every launch site routes through
+DEVICE_TELEMETRY = DeviceTelemetry()
+
+
+def register_program(name: str, *, flops_per_item: float | None = None,
+                     module: str = "") -> None:
+    DEVICE_TELEMETRY.register_program(
+        name, flops_per_item=flops_per_item, module=module)
+
+
+def record_launch(program: str, **kwargs) -> None:
+    DEVICE_TELEMETRY.record_launch(program, **kwargs)
+
+
+# ---------------------------------------------------------------------------
+# device / backend enumeration (lazy: never initializes a backend)
+# ---------------------------------------------------------------------------
+
+
+def _live_jax():
+    """The jax module IF some subsystem already imported it — this
+    plane must never be the reason a backend initializes."""
+    return sys.modules.get("jax")
+
+
+def update_device_gauges() -> list[dict]:
+    """Refresh per-device labels/memory gauges; returns the device
+    table (empty when JAX was never imported or has no backend)."""
+    jax = _live_jax()
+    if jax is None:
+        return []
+    try:
+        devices = jax.devices()
+    except Exception:
+        return []
+    by_platform: dict[tuple[str, str], int] = {}
+    table = []
+    for i, dev in enumerate(devices):
+        platform = str(getattr(dev, "platform", "unknown"))
+        kind = str(getattr(dev, "device_kind", "unknown"))
+        by_platform[(platform, kind)] = \
+            by_platform.get((platform, kind), 0) + 1
+        row = {"id": int(getattr(dev, "id", i)),
+               "label": _device_label(i),
+               "platform": platform, "kind": kind}
+        try:
+            stats = dev.memory_stats()
+        except Exception:
+            stats = None
+        if stats:
+            for k in ("bytes_in_use", "bytes_limit",
+                      "peak_bytes_in_use"):
+                if k in stats:
+                    row[k] = int(stats[k])
+            label = _device_label(i)
+            if "bytes_in_use" in stats:
+                DEVICE_MEMORY.labels(
+                    device=label, kind="bytes_in_use").set(
+                    stats["bytes_in_use"])
+            if "bytes_limit" in stats:
+                DEVICE_MEMORY.labels(
+                    device=label, kind="bytes_limit").set(
+                    stats["bytes_limit"])
+        table.append(row)
+    for (platform, kind), n in by_platform.items():
+        DEVICE_INFO.labels(platform=platform, kind=kind).set(n)
+    return table
+
+
+def env_fingerprint() -> dict:
+    """jax/jaxlib/libtpu versions + backend/device identity — the
+    self-describing stamp bench.py writes into every BENCH/MULTICHIP
+    JSON and the doctor leads its report with."""
+    import platform as _platform
+    out: dict = {"python": _platform.python_version()}
+    jax = _live_jax()
+    if jax is None:
+        try:
+            import jax  # the doctor/bench call sites want the probe
+        except Exception as exc:
+            out["jax"] = None
+            out["error"] = repr(exc)
+            return out
+    out["jax"] = getattr(jax, "__version__", None)
+    try:
+        import jaxlib
+        out["jaxlib"] = getattr(jaxlib, "__version__", None)
+    except Exception:
+        out["jaxlib"] = None
+    out["libtpu"] = _libtpu_version()
+    try:
+        out["backend"] = jax.default_backend()
+        devices = jax.devices()
+        out["device_count"] = len(devices)
+        out["device_kind"] = str(getattr(
+            devices[0], "device_kind", "unknown")) if devices else None
+    except Exception as exc:
+        out["backend"] = None
+        out["error"] = repr(exc)
+    return out
+
+
+def _libtpu_version() -> str | None:
+    try:
+        from importlib import metadata
+    except Exception:  # pragma: no cover — py<3.8 only
+        return None
+    for dist in ("libtpu", "libtpu-nightly"):
+        try:
+            return metadata.version(dist)
+        # absent distribution — probing, not failing
+        except Exception:  # bmlint: allow(silent-swallow)
+            continue
+    return None
+
+
+# ---------------------------------------------------------------------------
+# status documents / on-demand trace capture
+# ---------------------------------------------------------------------------
+
+
+def _series(name: str, program: str):
+    fam = REGISTRY.get(name)
+    if fam is None:
+        return None
+    for values, child in fam.children():
+        if values == (program,):
+            return child
+    return None
+
+
+def _counter_value(name: str, program: str) -> float:
+    child = _series(name, program)
+    return float(child.value) if child is not None else 0.0
+
+
+def _hist_stats(name: str, program: str) -> tuple[int, float]:
+    child = _series(name, program)
+    if child is None:
+        return 0, 0.0
+    _, total_sum, count = child.snapshot()
+    return count, total_sum
+
+
+def device_status() -> dict:
+    """The ``deviceStatus`` document: per-program attribution table +
+    device/backend identity (JSON-able, read-only, never raises into
+    the API path beyond what the registry itself would)."""
+    programs = {}
+    for name, spec in sorted(DEVICE_TELEMETRY.programs().items()):
+        launches = _counter_value("device_launches_total", name)
+        _, dispatch_sum = _hist_stats("device_dispatch_seconds", name)
+        _, wait_sum = _hist_stats("device_execute_wait_seconds", name)
+        h2d = _counter_value("device_h2d_bytes_total", name)
+        donated = _counter_value("device_donated_bytes_total", name)
+        programs[name] = {
+            "module": spec.get("module", ""),
+            "flopsPerItem": spec.get("flops_per_item"),
+            "launches": int(launches),
+            "compiles": int(_counter_value(
+                "device_program_compiles_total", name)),
+            "cacheHits": int(_counter_value(
+                "device_program_cache_hits_total", name)),
+            "compileSeconds": round(_hist_stats(
+                "device_program_compile_seconds", name)[1], 6),
+            "dispatchSeconds": round(dispatch_sum, 6),
+            "executeWaitSeconds": round(wait_sum, 6),
+            "busySeconds": round(_counter_value(
+                "device_busy_seconds_total", name), 6),
+            "h2dBytes": int(h2d),
+            "d2hBytes": int(_counter_value(
+                "device_d2h_bytes_total", name)),
+            "donatedBytes": int(donated),
+            "donationRate": round(donated / h2d, 4) if h2d else 0.0,
+            "workItems": int(_counter_value(
+                "device_work_items_total", name)),
+            "hashrateHps": round(REGISTRY.sample(
+                "device_hashrate_hps", {"program": name}), 2),
+            "mfu": round(REGISTRY.sample(
+                "device_mfu_ratio", {"program": name}), 6),
+        }
+    return {
+        "devices": update_device_gauges(),
+        "env": env_fingerprint() if _live_jax() is not None else
+               {"jax": None, "note": "jax not imported yet"},
+        "programs": programs,
+        "dropped": REGISTRY.sample("device_telemetry_dropped_total"),
+    }
+
+
+def device_cost_block() -> dict:
+    """The ``costStatus`` ``device`` block: the attribution shares a
+    cost view needs, without the full per-program table."""
+    progs = DEVICE_TELEMETRY.programs()
+    busy = {p: _counter_value("device_busy_seconds_total", p)
+            for p in progs}
+    total_busy = sum(busy.values())
+    return {
+        "busySeconds": round(total_busy, 6),
+        "byProgram": {p: round(s, 6) for p, s in sorted(busy.items())
+                      if s > 0},
+        "compileSeconds": round(sum(
+            _hist_stats("device_program_compile_seconds", p)[1]
+            for p in progs), 6),
+        "executeWaitSeconds": round(sum(
+            _hist_stats("device_execute_wait_seconds", p)[1]
+            for p in progs), 6),
+        "launches": int(sum(
+            _counter_value("device_launches_total", p)
+            for p in progs)),
+    }
+
+
+#: bound on one on-demand capture — a forgotten long trace would hold
+#: the profiler (and its buffer growth) for the whole session
+MAX_TRACE_SECONDS = 60.0
+
+
+def capture_device_trace(seconds: float,
+                         out_dir: str | None = None) -> dict:
+    """Run ``jax.profiler.trace`` for ``seconds`` and report the
+    artifact paths (the ``profileDevice`` / ``GET /debug/device``
+    backend).  Blocking — API callers run it in an executor."""
+    import os
+    import tempfile
+    seconds = float(seconds)
+    if not 0 < seconds <= MAX_TRACE_SECONDS:
+        raise ValueError("trace seconds must be in (0, %g]"
+                         % MAX_TRACE_SECONDS)
+    try:
+        import jax
+    except Exception as exc:  # pragma: no cover — jax is baked in
+        return {"ok": False, "error": "jax unavailable: %r" % exc}
+    trace_dir = out_dir or tempfile.mkdtemp(prefix="bmtpu_devtrace_")
+    t0 = time.monotonic()
+    try:
+        with jax.profiler.trace(trace_dir):
+            # launches from worker threads land in the trace while we
+            # hold it open
+            time.sleep(seconds)
+    except Exception as exc:
+        return {"ok": False, "error": repr(exc),
+                "traceDir": trace_dir}
+    files = []
+    for root, _dirs, names in os.walk(trace_dir):
+        for fname in names:
+            path = os.path.join(root, fname)
+            try:
+                size = os.path.getsize(path)
+            except OSError:
+                size = 0
+            files.append({"path": os.path.relpath(path, trace_dir),
+                          "bytes": size})
+    return {"ok": True, "traceDir": trace_dir,
+            "seconds": round(time.monotonic() - t0, 3),
+            "files": sorted(files, key=lambda f: f["path"])}

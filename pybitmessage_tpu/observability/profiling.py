@@ -61,6 +61,7 @@ from collections import Counter as _Counter
 from collections import deque
 from contextlib import contextmanager
 
+from .devicetelemetry import device_cost_block
 from .metrics import REGISTRY
 
 logger = logging.getLogger("pybitmessage_tpu.observability")
@@ -671,6 +672,7 @@ def cost_status(node=None, *, profiler: SamplingProfiler | None = None
         "ingestStages": ingest_stage_costs(),
         "farmTenants": farm_tenant_costs(),
         "cryptoRungs": crypto_rung_costs(),
+        "device": device_cost_block(),
     }
     if node is not None:
         out["node"] = getattr(node, "node_id", "")

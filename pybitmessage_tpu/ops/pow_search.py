@@ -22,6 +22,9 @@ from typing import Callable, Sequence
 import jax
 import jax.numpy as jnp
 
+from ..observability.devicetelemetry import (POW_FLOPS_PER_HASH,
+                                             record_launch,
+                                             register_program)
 from ..utils.hashes import double_sha512
 from .sha512_jax import (DEFAULT_VARIANT, double_sha512_trial,
     initial_hash_words, trial_values)
@@ -51,7 +54,9 @@ def _run_host_driver(search_once, initial_hash: bytes, target: int, *,
                      start_nonce: int, trials_per_call_step: int,
                      should_stop: Callable[[], bool] | None,
                      on_slab: Callable[[float], None] | None = None,
-                     progress: Callable[[int], None] | None = None):
+                     progress: Callable[[int], None] | None = None,
+                     program: str = "pow_slab", program_key=None,
+                     devices: int = 1):
     """Shared host loop over a jitted search slab.
 
     ``search_once(b_hi, b_lo) -> (found, n_hi, n_lo, chunks)``;
@@ -63,6 +68,10 @@ def _run_host_driver(search_once, initial_hash: bytes, target: int, *,
     the winning nonce with hashlib before returning, guarding against
     accelerator miscompute (the reference re-checks OpenCL results,
     proofofwork.py:302-313).
+
+    Every slab is attributed to the device-telemetry ``program``
+    (dispatch vs the ``int(chunks)`` completion pull, compile-vs-
+    cache on ``program_key``, hashes from the chunk count).
     """
     import time as _time
 
@@ -74,9 +83,16 @@ def _run_host_driver(search_once, initial_hash: bytes, target: int, *,
         b_hi, b_lo = u64_from_int(base)
         t0 = _time.monotonic()
         found, n_hi, n_lo, chunks = search_once(b_hi, b_lo)
+        t1 = _time.monotonic()
         chunks = int(chunks)          # host pull — forces completion
+        t2 = _time.monotonic()
+        record_launch(program, key=program_key,
+                      dispatch_seconds=t1 - t0, wait_seconds=t2 - t1,
+                      span=(t0, t2),
+                      items=chunks * trials_per_call_step,
+                      bytes_out=16, devices=devices)
         if on_slab is not None:
-            on_slab(_time.monotonic() - t0)
+            on_slab(t2 - t0)
         trials += chunks * trials_per_call_step
         if bool(found):
             nonce = u64_to_int(n_hi, n_lo)
@@ -174,7 +190,8 @@ def solve(initial_hash: bytes, target: int, *,
     return _run_host_driver(
         search_once, initial_hash, target, start_nonce=start_nonce,
         trials_per_call_step=lanes, should_stop=should_stop,
-        on_slab=on_slab, progress=progress)
+        on_slab=on_slab, progress=progress, program="pow_slab",
+        program_key=(lanes, chunks, variant))
 
 
 @jax.jit
@@ -216,5 +233,23 @@ def verify(items: Sequence[tuple[int, bytes, int]]) -> list[bool]:
     tl = jnp.array(tl_l + [0] * pad, dtype=U32)
     ih_hi = jnp.array(ih_hi_l + [[0] * 8] * pad, dtype=U32).T
     ih_lo = jnp.array(ih_lo_l + [[0] * 8] * pad, dtype=U32).T
+    import time as _time
+
+    import numpy as np
+    bytes_in = sum(int(a.nbytes) for a in
+                   (nh, nl, th, tl, ih_hi, ih_lo))
+    t0 = _time.monotonic()
     ok = pow_verify_batch(nh, nl, ih_hi, ih_lo, th, tl)
+    t1 = _time.monotonic()
+    ok = np.asarray(ok)               # the blocking completion pull
+    t2 = _time.monotonic()
+    record_launch("pow_verify", key=size, dispatch_seconds=t1 - t0,
+                  wait_seconds=t2 - t1, span=(t0, t2), items=size,
+                  bytes_in=bytes_in, bytes_out=int(ok.nbytes))
     return [bool(b) for b in ok[:n]]
+
+
+register_program("pow_slab", flops_per_item=POW_FLOPS_PER_HASH,
+                 module="ops/pow_search.py")
+register_program("pow_verify", flops_per_item=POW_FLOPS_PER_HASH,
+                 module="ops/pow_search.py")

@@ -601,3 +601,16 @@ def bucket_for(n: int) -> int:
         if n <= b:
             return b
     return BUCKETS[-1]
+
+
+# device-telemetry catalog: the jitted programs above are launched
+# (and blocked on) by crypto/tpu.TpuSecp's lane drains, which record
+# per-launch attribution; the declarations live with the kernels
+from ..observability.devicetelemetry import (SECP_ECDH_FLOPS,
+                                             SECP_VERIFY_FLOPS,
+                                             register_program)
+
+register_program("secp_verify", flops_per_item=SECP_VERIFY_FLOPS,
+                 module="ops/secp256k1_pallas.py")
+register_program("secp_ecdh", flops_per_item=SECP_ECDH_FLOPS,
+                 module="ops/secp256k1_pallas.py")

@@ -22,6 +22,9 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ..observability.devicetelemetry import (POW_FLOPS_PER_HASH,
+                                             record_launch,
+                                             register_program)
 from .sha512_jax import _H0, _K
 from .u64 import U32
 
@@ -564,29 +567,45 @@ def solve_batch(items, *, rows: int = DEFAULT_ROWS,
     ]
 
     def dispatch(g: _BatchGroup):
+        import time as _time
+
         import numpy as np
 
         b_arr = np.array(
             [[(b >> 32) & 0xFFFFFFFF, b & 0xFFFFFFFF] for b in g.bases],
             dtype=np.uint32)
+        live = sum(1 for d in g.done if not d)
+        uploaded = int(b_arr.nbytes)
+        t0 = _time.monotonic()
         # targets change only when an object solves; keeping the device
         # copy across launches saves one host->device transfer (a full
         # relay round trip) on every steady-state launch
         if g.t_dirty:
             g.t_dev = jnp.asarray(g.t_np.copy())
             g.t_dirty = False
+            uploaded += int(g.t_np.nbytes)
         out = pallas_batch_search(
             g.ih_words, b_arr, g.t_dev, rows=rows,
             chunks=chunks_per_call, unroll=unroll, interpret=interpret)
+        t1 = _time.monotonic()
         for k in range(BATCH_OBJS):
             if not g.done[k]:
                 g.bases[k] = (g.bases[k] + trials_per_slab) & mask64
-        return out
+        return out, live, uploaded, t0, t1
 
-    def harvest(g: _BatchGroup, out_dev):
+    def harvest(g: _BatchGroup, out_dev, live, uploaded, t0, t1):
+        import time as _time
+
         import numpy as np
 
+        t2 = _time.monotonic()
         out = np.asarray(out_dev)
+        t3 = _time.monotonic()
+        record_launch("batch_search",
+                      key=(rows, chunks_per_call, unroll, interpret),
+                      dispatch_seconds=t1 - t0, wait_seconds=t3 - t2,
+                      span=(t0, t3), items=live * trials_per_slab,
+                      bytes_in=uploaded, bytes_out=int(out.nbytes))
         for k in range(BATCH_OBJS):
             if g.done[k]:
                 continue
@@ -632,7 +651,7 @@ def solve_batch(items, *, rows: int = DEFAULT_ROWS,
         if cand is None and pending_g is not None \
                 and pending_g.harvested >= 1 and not pending_g.finished:
             cand = pending_g
-        cur = (cand, dispatch(cand)) if cand is not None else None
+        cur = (cand,) + dispatch(cand) if cand is not None else None
         if pending is not None and not pending[0].finished:
             harvest(*pending)
         pending = cur
@@ -727,9 +746,17 @@ def solve(initial_hash: bytes, target: int, *,
                              chunks=chunks, unroll=unroll,
                              interpret=interpret)
 
-    def harvest(found_dev, nonce_dev):
+    def harvest(found_dev, nonce_dev, t_disp, t_disp_end):
         """Sync one slab's results; returns the winning nonce or None."""
+        t_f = _time.monotonic()
         f = np.asarray(found_dev)
+        t_done = _time.monotonic()
+        record_launch("pallas_slab",
+                      key=(rows, chunks, unroll, interpret),
+                      dispatch_seconds=t_disp_end - t_disp,
+                      wait_seconds=t_done - t_f, span=(t_disp, t_done),
+                      items=trials_per_slab, bytes_in=8,
+                      bytes_out=int(f.nbytes))
         idx = int(f.argmax())
         if not f[idx]:
             return None
@@ -747,34 +774,45 @@ def solve(initial_hash: bytes, target: int, *,
 
     base = start_nonce & mask64
     trials = 0
-    pending = None  # ((found_dev, nonce_dev), dispatch_time, end_base)
+    # ((found_dev, nonce_dev), dispatch_start, dispatch_end, end_base)
+    pending = None
     while True:
         if should_stop is not None and should_stop():
             # the in-flight slab may already hold the answer — check
             # before discarding ~16.7M trials of completed device work
             if pending is not None:
                 trials += trials_per_slab
-                nonce = harvest(*pending[0])
+                nonce = harvest(*pending[0], pending[1], pending[2])
                 if nonce is not None:
                     return nonce, trials
                 if progress is not None:
-                    progress(pending[2])
+                    progress(pending[3])
             raise PowInterrupted("Pallas PoW interrupted by shutdown")
         end_base = (base + trials_per_slab) & mask64
-        current = (launch(base), _time.monotonic(), end_base)
+        t_disp = _time.monotonic()
+        out = launch(base)
+        current = (out, t_disp, _time.monotonic(), end_base)
         base = end_base
         if pending is not None:
             trials += trials_per_slab
-            nonce = harvest(*pending[0])
+            nonce = harvest(*pending[0], pending[1], pending[2])
             if tuner is not None:
                 # dispatch -> harvested wall of the pending slab: the
                 # cadence the autotuner steers toward target_seconds
                 tuner.record(tuner_kind, chunks,
-                             _time.monotonic() - pending[1])
+                             _time.monotonic() - pending[2])
             if nonce is not None:
                 return nonce, trials
             if progress is not None:
                 # the pending slab harvested miss-free: its end is the
                 # resumable-PoW checkpoint (resilience/journal.py)
-                progress(pending[2])
+                progress(pending[3])
         pending = current
+
+
+register_program("pallas_slab", flops_per_item=POW_FLOPS_PER_HASH,
+                 module="ops/sha512_pallas.py")
+register_program("batch_search", flops_per_item=POW_FLOPS_PER_HASH,
+                 module="ops/sha512_pallas.py")
+register_program("packed_search", flops_per_item=POW_FLOPS_PER_HASH,
+                 module="ops/sha512_pallas.py")

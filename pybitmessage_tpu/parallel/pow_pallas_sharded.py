@@ -37,6 +37,9 @@ import jax.numpy as jnp
 from ._compat import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ..observability.devicetelemetry import (POW_FLOPS_PER_HASH,
+                                             record_launch,
+                                             register_program)
 from ..ops.sha512_jax import DEFAULT_VARIANT, trial_values
 from ..ops.sha512_pallas import (BATCH_CHUNKS, BATCH_OBJS, BATCH_UNROLL,
                                  LANE_COLS, DEFAULT_CHUNKS,
@@ -46,6 +49,11 @@ from ..ops.u64 import U32, add64, le64, mul_u32_const
 from ..ops.pow_search import PowInterrupted
 
 _MASK64 = (1 << 64) - 1
+
+register_program("pod_slab", flops_per_item=POW_FLOPS_PER_HASH,
+                 module="parallel/pow_pallas_sharded.py")
+register_program("pod_batch", flops_per_item=POW_FLOPS_PER_HASH,
+                 module="parallel/pow_pallas_sharded.py")
 
 #: per-DEVICE object cap for the unrolled batch kernel — the same
 #: 64-object geometry the single-chip ``solve_batch`` compiles and
@@ -267,6 +275,8 @@ def pallas_sharded_solve(initial_hash: bytes, target: int, mesh: Mesh, *,
     checkpoints resumable search state whenever a pod slab harvests
     miss-free (same contract as ``sha512_pallas.solve``).
     """
+    import time as _time
+
     import numpy as np
 
     from ..utils.hashes import double_sha512
@@ -284,8 +294,16 @@ def pallas_sharded_solve(initial_hash: bytes, target: int, mesh: Mesh, *,
     slab = rows * LANE_COLS * chunks_per_call * unroll
     stride = nonce_devs * slab
 
-    def harvest(out):
+    def harvest(out, t0, t1):
+        t2 = _time.monotonic()
         found, n_hi, n_lo = np.asarray(out)     # one packed fetch
+        t3 = _time.monotonic()
+        record_launch("pod_slab",
+                      key=(rows, chunks_per_call, unroll, impl, interpret),
+                      dispatch_seconds=t1 - t0, wait_seconds=t3 - t2,
+                      span=(t0, t3), items=stride,
+                      bytes_in=int(ih_words.nbytes) + 16, bytes_out=12,
+                      devices=ndev)
         if not found:
             return None
         nonce = (int(n_hi) << 32) | int(n_lo)
@@ -296,23 +314,25 @@ def pallas_sharded_solve(initial_hash: bytes, target: int, mesh: Mesh, *,
 
     base = start_nonce & _MASK64
     trials = 0
-    pending = None      # (device_out, end_base of that slab)
+    pending = None      # (device_out, end_base, dispatch t0, t1)
     while True:
         if should_stop is not None and should_stop():
             if pending is not None:
                 trials += stride
-                nonce = harvest(pending[0])
+                nonce = harvest(pending[0], pending[2], pending[3])
                 if nonce is not None:
                     return nonce, trials
                 if progress is not None:
                     progress(pending[1])
             raise PowInterrupted("sharded Pallas PoW interrupted")
         end_base = (base + stride) & _MASK64
-        current = (fn(ih_words, _pair_arr(base), target_arr), end_base)
+        t0 = _time.monotonic()
+        out = fn(ih_words, _pair_arr(base), target_arr)
+        current = (out, end_base, t0, _time.monotonic())
         base = end_base
         if pending is not None:
             trials += stride
-            nonce = harvest(pending[0])
+            nonce = harvest(pending[0], pending[2], pending[3])
             if nonce is not None:
                 return nonce, trials
             if progress is not None:
@@ -427,19 +447,31 @@ def pallas_sharded_solve_batch(items, mesh: Mesh, *,
             the single-chip solve_batch)."""
             live = [i for i in range(group_objs) if not done[i]]
             b_arr = jnp.stack([_pair_arr(b) for b in bases])
+            t0 = _time.monotonic()
             out = fn(ih_words, b_arr, t_arr)
+            t1 = _time.monotonic()
             for i in live:
                 bases[i] = (bases[i] + stride) & _MASK64
             # per-slab end bases: the checkpoint each live object may
             # report once THIS slab harvests miss-free (bases keeps
             # advancing under dispatch-ahead, so snapshot now)
-            return out, live, {i: bases[i] for i in live}
+            return (out, live, {i: bases[i] for i in live},
+                    int(b_arr.nbytes), t0, t1)
 
-        def harvest(out_dev, live, end_bases):
+        def harvest(out_dev, live, end_bases, up_bytes, t0, t1):
             nonlocal t_arr
-            t0 = _time.monotonic()
+            t2 = _time.monotonic()
             packed = np.asarray(out_dev)          # the blocking fetch
-            _metrics.DEVICE_WAIT.observe(_time.monotonic() - t0)
+            t3 = _time.monotonic()
+            _metrics.DEVICE_WAIT.observe(t3 - t2)
+            record_launch("pod_batch",
+                          key=(rows, chunks_per_call, unroll, impl,
+                               interpret),
+                          dispatch_seconds=t1 - t0, wait_seconds=t3 - t2,
+                          span=(t0, t3), items=stride * len(live),
+                          bytes_in=up_bytes,
+                          bytes_out=int(packed.nbytes),
+                          devices=mesh.devices.size)
             found, n_hi, n_lo = packed[:, 0], packed[:, 1], packed[:, 2]
             steps = packed[:, 3]
             for i in live:

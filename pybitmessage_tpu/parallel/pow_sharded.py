@@ -21,12 +21,20 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 from ._compat import shard_map
 
+from ..observability.devicetelemetry import (POW_FLOPS_PER_HASH,
+                                             record_launch,
+                                             register_program)
 from ..ops.pow_search import PowInterrupted, _run_host_driver
 from ..ops.sha512_jax import (DEFAULT_VARIANT, initial_hash_words,
     trial_values)
 from ..ops.u64 import add64, le64, u64_from_int, U32
 
 _MASK64 = (1 << 64) - 1
+
+register_program("sharded_search", flops_per_item=POW_FLOPS_PER_HASH,
+                 module="parallel/pow_sharded.py")
+register_program("sharded_batch", flops_per_item=POW_FLOPS_PER_HASH,
+                 module="parallel/pow_sharded.py")
 
 
 def _device_search(ih_hi, ih_lo, t_hi, t_lo, s_hi, s_lo,
@@ -202,7 +210,10 @@ def sharded_solve_batch(items, mesh: Mesh, *, lanes: int = 1 << 13,
     t_hi = jnp.array([t >> 32 for t in targets], dtype=U32)
     t_lo = jnp.array([t & 0xFFFFFFFF for t in targets], dtype=U32)
 
+    import time as _time
+
     step = lanes * nonce_size            # trials per object per chunk
+    ndev = mesh.devices.size
     bases = [0] * total
     trials = [0] * total
     nonces: list[int | None] = [None] * total
@@ -211,8 +222,18 @@ def sharded_solve_batch(items, mesh: Mesh, *, lanes: int = 1 << 13,
             raise PowInterrupted("batched PoW interrupted by shutdown")
         s_hi = jnp.array([(b >> 32) & 0xFFFFFFFF for b in bases], dtype=U32)
         s_lo = jnp.array([b & 0xFFFFFFFF for b in bases], dtype=U32)
-        found, n_hi, n_lo, chunks = (
-            np.asarray(x) for x in fn(ih_hi, ih_lo, t_hi, t_lo, s_hi, s_lo))
+        t0 = _time.monotonic()
+        out_dev = fn(ih_hi, ih_lo, t_hi, t_lo, s_hi, s_lo)
+        t1 = _time.monotonic()
+        found, n_hi, n_lo, chunks = (np.asarray(x) for x in out_dev)
+        t2 = _time.monotonic()
+        record_launch("sharded_batch",
+                      key=(lanes, chunks_per_call, total, variant),
+                      dispatch_seconds=t1 - t0, wait_seconds=t2 - t1,
+                      span=(t0, t2),
+                      items=int(chunks.sum()) * step,
+                      bytes_in=int(s_hi.nbytes + s_lo.nbytes),
+                      bytes_out=16 * total, devices=ndev)
         for i in range(total):
             c = int(chunks[i])
             if nonces[i] is not None:
@@ -254,4 +275,7 @@ def sharded_solve(initial_hash: bytes, target: int, mesh: Mesh, *,
 
     return _run_host_driver(
         search_once, initial_hash, target, start_nonce=start_nonce,
-        trials_per_call_step=lanes * ndev, should_stop=should_stop)
+        trials_per_call_step=lanes * ndev, should_stop=should_stop,
+        program="sharded_search",
+        program_key=(lanes, chunks_per_call, ndev, variant),
+        devices=ndev)

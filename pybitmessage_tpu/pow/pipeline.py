@@ -49,6 +49,9 @@ import jax
 import jax.numpy as jnp
 
 from ..observability import DEFAULT_SIZE_BUCKETS, REGISTRY
+from ..observability.devicetelemetry import (POW_FLOPS_PER_HASH,
+                                             record_launch,
+                                             register_program)
 from ..observability.flightrec import record as _flight
 from ..ops.pow_search import PowInterrupted
 from ..resilience.chaos import inject
@@ -229,6 +232,10 @@ def _packed_search_xla(ih_words, bases, targets, lanes: int, chunks: int):
         return jnp.stack([step1, nhs[first], nls[first]])
 
     return jax.vmap(one)(ih_words, bases, targets)
+
+
+register_program("packed_search_xla", flops_per_item=POW_FLOPS_PER_HASH,
+                 module="pow/pipeline.py")
 
 
 # ---------------------------------------------------------------------------
@@ -574,6 +581,19 @@ def solve_batch_pipelined(items, *, rows: int = DEFAULT_ROWS,
         kind = "batch"
     slab_trials = step_trials * plan.chunks     # per object per launch
 
+    # device-telemetry attribution: which jitted program this plan
+    # actually launches, plus the static-shape key that decides
+    # compile-vs-cache (mirrors each kernel's static_argnames)
+    if impl != "pallas":
+        tele_prog = "packed_search_xla"
+        tele_key = (step_trials, plan.chunks)
+    elif plan.mode == "packed":
+        tele_prog = "packed_search"
+        tele_key = (rows, plan.chunks, pack, unroll, interpret)
+    else:
+        tele_prog = "batch_search"
+        tele_key = (rows, plan.chunks, unroll, interpret)
+
     groups = [
         _LaunchGroup(items, plan.order[s:s + width], width,
                      starts=start_nonces)
@@ -632,6 +652,7 @@ def solve_batch_pipelined(items, *, rows: int = DEFAULT_ROWS,
             PACK_OCCUPANCY.set(live / cand.width)
         t0 = time.monotonic()
         out = search(cand)
+        t1 = time.monotonic()
         inflight_groups.add(id(cand))
         cand.launches += 1
         executed["launches"] += 1
@@ -642,15 +663,34 @@ def solve_batch_pipelined(items, *, rows: int = DEFAULT_ROWS,
         # point to checkpoint once THIS slab harvests miss-free (the
         # live ``bases`` may already include speculative launches)
         end_bases = list(cand.bases)
-        return ((cand, t0, end_bases), out)
+        return ((cand, t0, t1, end_bases), out)
+
+    seen_wait = {"v": 0.0}
 
     def harvest(tag, out):
-        g, t0, end_bases = tag
+        g, t0, t1, end_bases = tag
         inflight_groups.discard(id(g))
+        t_h = time.monotonic()
         # normalize by the launch's total grid steps so storm-wide and
         # narrow launches feed one per-step EWMA
-        autotuner.record(kind, plan.chunks * (g.width // pack),
-                         time.monotonic() - t0)
+        autotuner.record(kind, plan.chunks * (g.width // pack), t_h - t0)
+        # the driver accumulated this harvest's blocking fetch into
+        # wait_seconds just before calling us — the delta since the
+        # last harvest is THIS slab's device wait
+        wait_dt = driver.wait_seconds - seen_wait["v"]
+        seen_wait["v"] = driver.wait_seconds
+        before = executed["trials"]
+        _record_pipeline_launch = functools.partial(
+            record_launch, tele_prog, key=tele_key,
+            dispatch_seconds=t1 - t0, wait_seconds=wait_dt,
+            span=(t0, t_h), bytes_in=16 * g.width,
+            bytes_out=12 * g.width,
+            # the packed Mosaic kernel donates its base/target input
+            # buffers (see _solve_single_sync's fresh-per-iteration
+            # note); XLA and batch launches keep theirs
+            bytes_donated=(16 * g.width
+                           if impl == "pallas" and plan.mode == "packed"
+                           else 0))
         for k in range(g.width):
             if g.done[k]:
                 # solved/pad slots still executed one always-hit step
@@ -677,6 +717,7 @@ def solve_batch_pipelined(items, *, rows: int = DEFAULT_ROWS,
                     # this slab proved [prev, end_bases[k]) miss-free:
                     # a resumed search may safely start there
                     progress(g.idx[k], end_bases[k])
+        _record_pipeline_launch(items=executed["trials"] - before)
 
     driver = _PipelineDriver(depth=depth, should_stop=should_stop,
                              stall_timeout=stall_timeout)
@@ -736,9 +777,23 @@ def _solve_single_sync(item, *, rows: int, unroll: int, chunks: int,
         else:
             out = _packed_search_xla(ih_words, b_arr, t_arr,
                                      lanes=step_trials, chunks=chunks)
+        t1 = time.monotonic()
         inject("pow.readback")
         out = np.asarray(out)
-        autotuner.record("packed", chunks, time.monotonic() - t0)
+        t2 = time.monotonic()
+        autotuner.record("packed", chunks, t2 - t0)
+        if impl == "pallas":
+            record_launch("packed_search",
+                          key=(rows, chunks, 1, unroll, interpret),
+                          dispatch_seconds=t1 - t0, wait_seconds=t2 - t1,
+                          span=(t0, t2), items=slab_trials, bytes_in=16,
+                          bytes_out=int(out.nbytes), bytes_donated=16)
+        else:
+            record_launch("packed_search_xla",
+                          key=(step_trials, chunks),
+                          dispatch_seconds=t1 - t0, wait_seconds=t2 - t1,
+                          span=(t0, t2), items=slab_trials, bytes_in=16,
+                          bytes_out=int(out.nbytes))
         step1 = int(out[0, 0])
         if step1:
             trials += step1 * step_trials

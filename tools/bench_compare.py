@@ -143,6 +143,14 @@ GUARDS: list[tuple[str, str, float]] = [
      "atmost", 0.02),
     ("configs.ingest_storm.wide_host.attribution.crypto_share",
      "atleast", 0.25),
+    # device telemetry plane (ISSUE 16): per-launch attribution must
+    # cost well under the standing 2% observability budget on the
+    # PR 1 harness shape, and every launch the harness issued must
+    # land in the registry (populated, nothing dropped)
+    ("configs.ingest_storm.device_telemetry.overhead_frac",
+     "atmost", 0.02),
+    ("configs.ingest_storm.device_telemetry.populated_zero_loss",
+     "equal", 1.0),
     # sync: machine-independent bandwidth ratios + the loss invariant
     ("configs.sync_storm.announce_reduction_x", "higher", 0.30),
     ("configs.sync_storm.catchup_reduction_x", "higher", 0.30),

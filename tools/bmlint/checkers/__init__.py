@@ -5,6 +5,7 @@ exposing ``name``/``rules``/``check_file``/``finish`` plus a line here
 from .awaitrace import AwaitRaceChecker
 from .blocking import BlockingCallChecker
 from .chaos import ResilienceChecker
+from .devicelaunch import DeviceLaunchChecker
 from .metricsconv import MetricsChecker
 from .swallow import SilentSwallowChecker
 from .threads import ThreadNamingChecker
@@ -16,6 +17,7 @@ CHECKERS = (
     SilentSwallowChecker,
     MetricsChecker,
     ResilienceChecker,
+    DeviceLaunchChecker,
     ThreadNamingChecker,
 )
 

@@ -1,0 +1,153 @@
+"""Checker: device-launch telemetry routing and catalog lockstep.
+
+The device-telemetry convention (docs/observability.md "Device
+telemetry"): every module in the accelerator packages (ops/,
+parallel/, crypto/) that creates a jitted or Pallas program —
+``jax.jit(...)``, ``@functools.partial(jax.jit, ...)`` or
+``pl.pallas_call(...)`` — must route its launches through
+``observability.devicetelemetry``: register its program names with
+``register_program`` and attribute launches with ``record_launch``
+(directly or via a shared host driver).  Otherwise its compiles,
+launches and transfer bytes are invisible to deviceStatus /
+costStatus and the MFU accounting undercounts.
+
+Lockstep, mirroring the chaos-site catalog: the program catalog lives
+in ``observability/devicetelemetry.py``'s module docstring (rows
+shaped ````name````).  Every cataloged program must be
+``register_program("<name>", ...)``-declared somewhere in the package
+(``device-program-unregistered``) and every literal registration must
+be cataloged (``device-program-undocumented``) — so the doctor's probe
+table, the docs and the live registry can never drift apart silently.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from ..core import FileCtx, Finding, call_name, dotted, str_const
+
+_CATALOG_ROW = re.compile(r"^``([a-z_][a-z0-9_.]*)``", re.MULTILINE)
+_LAUNCH_DIRS = frozenset({"ops", "parallel", "crypto"})
+_TELEMETRY_MODULE = "pybitmessage_tpu/observability/devicetelemetry.py"
+#: any of these names referenced in a module counts as routing through
+#: the telemetry plane (registration at import time, recording at
+#: launch time, or driving the singleton directly)
+_ROUTING_NAMES = frozenset(
+    {"register_program", "record_launch", "DEVICE_TELEMETRY"})
+
+
+class DeviceLaunchChecker:
+    name = "devicelaunch"
+    rules = ("device-launch-unrouted", "device-program-unregistered",
+             "device-program-undocumented")
+
+    def __init__(self):
+        self._catalog: dict[str, int] = {}   # program -> docstring line
+        self._catalog_path: str | None = None
+        self._registered: set[str] = set()
+        self._undocumented: dict[str, Finding] = {}
+        self._full_sweep = False
+
+    def check_file(self, ctx: FileCtx):
+        out: list[Finding] = []
+        if ctx.relpath == "pybitmessage_tpu/__init__.py":
+            # package root in the sweep -> "never registered" is a real
+            # coverage gap, not an artifact of a path-subset run
+            self._full_sweep = True
+        if ctx.relpath.endswith(_TELEMETRY_MODULE) or \
+                ctx.relpath == "observability/devicetelemetry.py":
+            self._read_catalog(ctx)
+            return out       # the registry itself launches nothing
+        if ctx.relpath.startswith("pybitmessage_tpu/"):
+            self._collect_registrations(ctx)
+        if ctx.top_dir in _LAUNCH_DIRS:
+            self._check_routing(ctx, out)
+        return out
+
+    def finish(self):
+        out: list[Finding] = []
+        if self._catalog_path is None or not self._full_sweep:
+            return out
+        for prog, line in sorted(self._catalog.items()):
+            if prog not in self._registered:
+                out.append(Finding(
+                    rule="device-program-unregistered",
+                    path=self._catalog_path, line=line, col=0,
+                    severity="error", scope="<module>",
+                    message="cataloged device program %r is never "
+                            "register_program()ed — deviceStatus and "
+                            "the tpu_doctor probe table no longer "
+                            "cover it" % prog))
+        for prog, f in sorted(self._undocumented.items()):
+            if prog not in self._catalog:
+                out.append(f)
+        return out
+
+    # -- catalog / registrations --------------------------------------------
+
+    def _read_catalog(self, ctx: FileCtx) -> None:
+        self._catalog_path = ctx.relpath
+        doc = ast.get_docstring(ctx.tree, clean=False) or ""
+        for m in _CATALOG_ROW.finditer(doc):
+            line = 1 + doc[:m.start()].count("\n")
+            self._catalog[m.group(1)] = line
+
+    def _collect_registrations(self, ctx: FileCtx) -> None:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if call_name(node).rsplit(".", 1)[-1] != "register_program":
+                continue
+            prog = str_const(node.args[0] if node.args else None)
+            if prog is None:
+                continue
+            self._registered.add(prog)
+            f = ctx.finding(
+                "device-program-undocumented", node,
+                "register_program(%r) is not in the observability/"
+                "devicetelemetry.py program catalog — add a docstring "
+                "row so the metric tables and doctor stay in lockstep"
+                % prog)
+            if not ctx.is_suppressed(f):
+                self._undocumented.setdefault(prog, f)
+
+    # -- launch-site routing -------------------------------------------------
+
+    def _check_routing(self, ctx: FileCtx,
+                       out: list[Finding]) -> None:
+        sites = [node for node in ast.walk(ctx.tree)
+                 if isinstance(node, ast.Attribute)
+                 and self._is_launch_site(node)]
+        if not sites:
+            return
+        if self._module_routes(ctx):
+            return
+        for node in sites:
+            out.append(ctx.finding(
+                "device-launch-unrouted", node,
+                "%s builds a jitted/Pallas program but the module "
+                "never touches the device-telemetry plane — "
+                "register_program() its program names and "
+                "record_launch() each launch so compiles/launches/"
+                "transfers show up in deviceStatus "
+                "(docs/observability.md)" % dotted(node)))
+
+    @staticmethod
+    def _is_launch_site(node: ast.Attribute) -> bool:
+        path = dotted(node)
+        return path == "jax.jit" or path.endswith("pallas_call")
+
+    @staticmethod
+    def _module_routes(ctx: FileCtx) -> bool:
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Name) and \
+                    node.id in _ROUTING_NAMES:
+                return True
+            if isinstance(node, ast.Attribute) and \
+                    node.attr in _ROUTING_NAMES:
+                return True
+            if isinstance(node, ast.alias) and \
+                    node.name in _ROUTING_NAMES:
+                return True
+        return False
