@@ -210,8 +210,16 @@ async def run(args) -> int:
         engine.use_native = settings.getbool("cryptonative")
         engine.use_tpu = crypto_tpu.mode() != "off"
         engine.tpu_batch_min = settings.getint("cryptotpubatchmin")
+        engine.drain_max = settings.getint("cryptodrainmax")
         engine.window = settings.getfloat("cryptobatchwindow")
         engine.num_threads = settings.getint("cryptonativethreads")
+    # trial-decrypt negative screen (ISSUE 17, docs/crypto.md): the
+    # processor attaches one by default; the knob detaches it from
+    # both the pool probe and the engine's no-match recorder
+    if not settings.getbool("cryptoscreen"):
+        node.processor.crypto.screen = None
+        if node.processor.crypto.batch is not None:
+            node.processor.crypto.batch.screen = None
     queue = node.ctx.object_queue
     if hasattr(queue, "high"):
         queue.high = settings.getint("ingestqueuehigh")

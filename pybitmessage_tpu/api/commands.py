@@ -316,11 +316,9 @@ class CommandHandler:
         return "success"
 
     def _delete_identity(self, address):
-        ks = self.node.keystore
-        ident = ks.identities.pop(address)
-        ks.by_ripe.pop(ident.ripe, None)
-        ks.by_tag.pop(ident.tag, None)
-        ks.save()
+        # KeyStore.remove bumps the keyring epoch, flushing the
+        # trial-decrypt negative screen (crypto/screen.py)
+        self.node.keystore.remove(address)
 
     def cmd_enableAddress(self, address, enable=True):
         ident = self.node.keystore.get(address)
@@ -974,7 +972,19 @@ class CommandHandler:
                     "tpu": engine.tpu_breaker.snapshot()["state"],
                     "native": engine.breaker.snapshot()["state"],
                 },
+                # transposed trial-decrypt drain shape (ISSUE 17)
+                "drains": {
+                    "budget": engine.drain_max,
+                    "count": engine.drains,
+                    "ecdhPairs": engine.drain_pairs,
+                    "meanWidth": round(
+                        engine.drain_pairs / engine.drains, 1)
+                    if engine.drains else 0.0,
+                },
             })
+        screen = getattr(getattr(self.node.processor, "crypto", None),
+                         "screen", None)
+        out["screen"] = screen.snapshot() if screen is not None else None
         out["fallbacks"] = {
             "tpu": int(REGISTRY.sample("crypto_tpu_fallback_total")),
             "native": int(REGISTRY.sample(

@@ -113,10 +113,17 @@ DEFAULTS: dict[str, str] = {
                                      # backend, on = force (XLA path
                                      # on CPU — the CI parity mode),
                                      # off = never probe
-    "cryptotpubatchmin": "64",       # min drain size (checks +
-                                     # trial-decrypt objects) worth a
+    "cryptotpubatchmin": "64",       # min effective drain fan (checks
+                                     # + ECDH candidate pairs) worth a
                                      # device launch; smaller drains
                                      # start at the native rung
+    "cryptodrainmax": "4096",        # ECDH pair budget per transposed
+                                     # trial-decrypt drain
+                                     # (docs/crypto.md)
+    "cryptoscreen": "true",          # object-keyed negative cache in
+                                     # front of the trial-decrypt
+                                     # sweep (epoch-invalidated on
+                                     # keyring changes)
     # -- set-reconciliation sync (docs/sync.md) --
     "syncenabled": "true",           # sketch-based inventory sync
                                      # (negotiated; old peers keep
@@ -342,6 +349,8 @@ VALIDATORS: dict[str, Callable[[str], bool]] = {
                                          "false", "0", "1", "yes",
                                          "no"),
     "cryptotpubatchmin": _validate_int_range(1, 1 << 20),
+    "cryptodrainmax": _validate_int_range(1, 1 << 20),
+    "cryptoscreen": _validate_bool,
     "syncenabled": _validate_bool,
     "syncinterval": _validate_float_range(0.5, 3600.0),
     "syncfanout": _validate_int_range(-1, 1000),

@@ -24,14 +24,16 @@ it (ISSUE 13: tpu -> native -> pure):
 2. **native** — ``tpu_secp_verify_batch`` for ECDSA (scalar prep
    u1 = e/s, u2 = r/s stays in Python; digest order follows the
    per-pubkey hint table in ``crypto/signing.py``) and
-   ``tpu_secp_ecdh_batch`` for ECIES, which fans one object's
-   ephemeral point across candidate identity scalars.  Trial decrypts
-   scan candidates in WAVEFRONT rounds — round k computes ECDH for
-   the k-th candidate of every still-unmatched object in one call —
-   so the batch path keeps the sequential path's first-match
-   early-exit (an object is encrypted to exactly one key) while
-   amortizing calls across objects.  MAC-first rejection: AES runs
-   only for the one real match.
+   ``tpu_secp_ecdh_batch`` for ECIES.  Trial decrypts run as a
+   TRANSPOSED WAVEFRONT (ISSUE 17): the (still-unmatched objects x
+   candidate keys) cross-product is flattened wavefront-major into
+   drains of up to ``drain_max`` ECDH pairs, one backend call per
+   drain — a 4-object x 10k-key sweep is three 4096-wide launches
+   instead of 10k width-<=4 rounds.  Settlement stays per object and
+   first-match-wins in candidate order (bit-identical to the old
+   per-round wavefront); matched objects prune their remaining pairs
+   between drains.  MAC-first rejection: AES runs only for the one
+   real match.
 3. **pure** — the per-item ``crypto.signing`` / ``crypto.ecies``
    ladder (OpenSSL-backed ``cryptography`` when installed, else
    pure Python), fanned across a small thread pool.  Entered when the
@@ -95,6 +97,12 @@ SHUTDOWN_SETTLED = REGISTRY.counter(
     "Checks still pending at engine shutdown, settled deterministically "
     "(verify False / decrypt no-match) instead of leaking "
     "CancelledError into the ingest workers")
+DRAIN_WIDTH = REGISTRY.histogram(
+    "crypto_ecdh_drain_size",
+    "ECDH pairs per transposed trial-decrypt drain (one backend call "
+    "each; budget-capped by cryptodrainmax) — the shape that must "
+    "clear cryptotpubatchmin for the tpu rung to earn its launch",
+    buckets=DEFAULT_SIZE_BUCKETS)
 
 _N = fallback.N
 
@@ -107,10 +115,13 @@ class _VerifyJob:
 
 
 class _DecryptJob:
-    __slots__ = ("payload", "candidates", "fut")
+    __slots__ = ("payload", "candidates", "fut", "tag", "epoch")
 
-    def __init__(self, payload, candidates, fut):
+    def __init__(self, payload, candidates, fut, tag=None, epoch=0):
         self.payload, self.candidates, self.fut = payload, candidates, fut
+        #: negative-screen key + the keyring epoch the sweep began
+        #: under (crypto/screen.py); tag None = caller screens nothing
+        self.tag, self.epoch = tag, epoch
 
 
 class BatchCryptoEngine:
@@ -132,18 +143,33 @@ class BatchCryptoEngine:
     ``use_tpu=False`` pins the accelerator rung off (the ``cryptotpu``
     knob); with it on, availability still follows ``crypto/tpu.py``'s
     probe/mode/force-disable state.  ``tpu_batch_min`` is the minimum
-    drain size (verify checks + trial-decrypt objects) worth a device
-    launch — smaller drains start at the native rung
-    (``cryptotpubatchmin``; docs/crypto.md discusses tuning).
+    EFFECTIVE drain fan (verify checks + ECDH candidate pairs) worth a
+    device launch — smaller drains start at the native rung
+    (``cryptotpubatchmin``; docs/crypto.md discusses tuning).  Pairs,
+    not objects: a 4-object x 1k-key sweep is 4k scalar mults and
+    absolutely worth the launch, which the old object-count gate
+    refused.
+
+    ``drain_max`` caps the ECDH pairs packed into one transposed
+    trial-decrypt drain (``cryptodrainmax``) — it bounds both the
+    per-call latency and the wasted work when a match lands mid-drain.
+
+    ``screen`` (optional, attached by the owning ObjectProcessor) is
+    the crypto/screen.py negative cache; completed no-match sweeps of
+    tagged jobs are recorded there.  Conservative settlements
+    (_settle: drain failure, shutdown) never insert — only a rung that
+    actually swept every candidate proves a no-match.
     """
 
     def __init__(self, *, use_native: bool = True, window: float = 0.0,
                  num_threads: int = 1, use_tpu: bool = True,
-                 tpu_batch_min: int = 64,
+                 tpu_batch_min: int = 64, drain_max: int = 4096,
                  breaker: CircuitBreaker | None = None):
         self.use_native = use_native
         self.use_tpu = use_tpu
         self.tpu_batch_min = tpu_batch_min
+        self.drain_max = drain_max
+        self.screen = None
         self.window = window
         self.num_threads = num_threads
         self.queue: asyncio.Queue = asyncio.Queue()
@@ -160,6 +186,11 @@ class BatchCryptoEngine:
         self.native_items = 0
         self.pure_items = 0
         self.last_path: str | None = None
+        #: transposed-drain shape (clientStatus crypto block): total
+        #: drains executed and ECDH pairs across them (dispatch-thread
+        #: only — no lock needed)
+        self.drains = 0
+        self.drain_pairs = 0
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -244,16 +275,23 @@ class BatchCryptoEngine:
     async def try_decrypt(
             self, payload: bytes,
             candidates: Sequence[tuple[bytes, object]],
+            *, tag: bytes | None = None, epoch: int = 0,
     ) -> list[tuple[bytes, object]]:
         """ECIES trial-decrypt one object against candidate keys,
         coalesced with other objects' sweeps.  Returns the (usually 0-
         or 1-element) ``(plaintext, handle)`` match list, preserving
-        the caller's candidate order semantics (first match wins)."""
+        the caller's candidate order semantics (first match wins).
+
+        ``tag``/``epoch``: negative-screen key and the keyring epoch
+        the caller observed before submitting — a genuinely completed
+        no-match sweep is recorded in ``self.screen`` under that key
+        (dropped if the keyring moved mid-sweep)."""
         candidates = list(candidates)
         if not candidates:
             return []
         fut = asyncio.get_running_loop().create_future()
-        await self.queue.put(_DecryptJob(payload, candidates, fut))
+        await self.queue.put(
+            _DecryptJob(payload, candidates, fut, tag, epoch))
         return await fut
 
     # -- drain loop ----------------------------------------------------------
@@ -325,6 +363,7 @@ class BatchCryptoEngine:
             BATCH_SECONDS.labels(op="decrypt").observe(
                 time.monotonic() - tv)
         RUNG_SECONDS.labels(rung=path).inc(time.monotonic() - t0)
+        self._screen_note(decrypts, d_res)
         breaker.record_success()
         setattr(self, path + "_items",
                 getattr(self, path + "_items")
@@ -344,9 +383,14 @@ class BatchCryptoEngine:
         rungs release the GIL for the whole batch; the pure tier fans
         across ``_fanout``.
         """
-        drain = len(verifies) + len(decrypts)
+        # launch-worthiness is judged on the EFFECTIVE fan — verify
+        # checks plus ECDH candidate pairs — not the job count: a few
+        # objects against a wide keyring is exactly the transposed
+        # drain shape the tpu rung exists for (ISSUE 17)
+        fan = (len(verifies)
+               + sum(len(j.candidates) for j in decrypts))
         tpu = (self._tpu_engine()
-               if drain >= self.tpu_batch_min else None)
+               if fan >= self.tpu_batch_min else None)
         if tpu is not None and self.tpu_breaker.allow():
             try:
                 inject("crypto.tpu")
@@ -382,10 +426,22 @@ class BatchCryptoEngine:
             BATCH_SECONDS.labels(op="decrypt").observe(
                 time.monotonic() - tv)
         RUNG_SECONDS.labels(rung="pure").inc(time.monotonic() - t0)
+        self._screen_note(decrypts, d_res)
         self.pure_items += len(verifies) + len(decrypts)
         self._count(verifies, decrypts, "pure")
         self.last_path = "pure"
         return v_res, d_res
+
+    def _screen_note(self, decrypts, d_res) -> None:
+        """Record genuinely completed no-match sweeps in the negative
+        screen.  Called ONLY after a rung ran the full sweep — never
+        from _settle, whose conservative no-matches prove nothing."""
+        screen = self.screen
+        if screen is None:
+            return
+        for job, matches in zip(decrypts, d_res):
+            if job.tag is not None and not matches:
+                screen.insert(job.tag, job.epoch)
 
     @staticmethod
     def _count(verifies, decrypts, path: str) -> None:
@@ -484,44 +540,67 @@ class BatchCryptoEngine:
         return results
 
     def _backend_decrypt(self, backend, decrypts):
-        """Wavefront trial decryption: round k computes ECDH for the
-        k-th candidate of every still-unmatched object in ONE native
-        call, then MAC-checks; AES runs only for the real match."""
+        """Transposed wavefront trial decryption (ISSUE 17): the
+        (still-unmatched objects x candidate keys) cross-product is
+        flattened WAVEFRONT-MAJOR — candidate k of every live object
+        before candidate k+1 of any — into drains of up to
+        ``drain_max`` pairs, ONE backend call per drain.  Settlement
+        walks each drain in plan order, so within an object the lowest
+        candidate index that passes ECDH -> MAC -> unpad wins, exactly
+        the per-round wavefront's first-match semantics; matched
+        objects prune their remaining pairs between drains.  MAC-first
+        rejection: AES runs only for the real match."""
         from . import ecies
         from .keys import priv_scalar32
         results: list[list] = [[] for _ in decrypts]
-        parsed = []
+        parsed: list = [None] * len(decrypts)
+        #: next candidate index per object
+        cursor = [0] * len(decrypts)
         live = []
         for i, job in enumerate(decrypts):
             try:
-                pp = ecies.parse_payload(job.payload)
+                parsed[i] = ecies.parse_payload(job.payload)
             except ValueError:
-                parsed.append(None)
                 continue
-            parsed.append(pp)
             live.append(i)
-        rnd = 0
+        drain_max = max(1, self.drain_max)
         while live:
+            # plan one budget-capped drain: wavefront-major passes
+            # over the live objects, one candidate each per pass
+            pairs: list[tuple[int, int]] = []
+            while len(pairs) < drain_max:
+                progressed = False
+                for i in live:
+                    if len(pairs) >= drain_max:
+                        break
+                    if cursor[i] < len(decrypts[i].candidates):
+                        pairs.append((i, cursor[i]))
+                        cursor[i] += 1
+                        progressed = True
+                if not progressed:
+                    break
             points, scalars, idx = [], [], []
-            for i in live:
-                priv, _handle = decrypts[i].candidates[rnd]
+            for i, j in pairs:
+                priv, _handle = decrypts[i].candidates[j]
                 try:
                     scalar = priv_scalar32(priv)
                 except ValueError:
                     continue            # invalid key: a miss
                 points.append(parsed[i].ephem_pub[1:])
                 scalars.append(scalar)
-                idx.append(i)
+                idx.append((i, j))
             if idx:
+                DRAIN_WIDTH.observe(len(idx))
+                self.drains += 1
+                self.drain_pairs += len(idx)
                 xs = backend.ecdh_batch(len(idx), b"".join(points),
                                         b"".join(scalars),
                                         nthreads=self.num_threads)
             else:
                 xs = []
-            nxt = set(live)
-            for i, x in zip(idx, xs):
-                if x is None:
-                    continue
+            for (i, j), x in zip(idx, xs):
+                if x is None or results[i]:
+                    continue            # bad point / already matched
                 pp = parsed[i]
                 key_e, key_m = ecies.kdf(x)
                 if not ecies.mac_ok(key_m, pp.macdata, pp.tag):
@@ -531,11 +610,9 @@ class BatchCryptoEngine:
                 except ValueError:
                     continue            # MAC-approved but unpaddable
                 results[i].append((plain,
-                                   decrypts[i].candidates[rnd][1]))
-                nxt.discard(i)          # first match wins; stop sweep
-            rnd += 1
-            live = [i for i in nxt
-                    if rnd < len(decrypts[i].candidates)]
+                                   decrypts[i].candidates[j][1]))
+            live = [i for i in live if not results[i]
+                    and cursor[i] < len(decrypts[i].candidates)]
         return results
 
     # -- pure tier -----------------------------------------------------------

@@ -142,8 +142,10 @@ class NativeSecp:
                    nthreads: int | None = None) -> list[bytes | None]:
         """Batch ECDH: per item, scalar_i * point_i -> 32-byte raw X
         (the exact ECDH_compute_key bytes the ECIES KDF hashes), or
-        None for an invalid point/scalar.  The hot ECIES shape repeats
-        ONE object's ephemeral point across all candidate scalars.
+        None for an invalid point/scalar.  The hot ECIES shape is the
+        transposed trial-decrypt drain (crypto/batch.py): the flattened
+        (objects x candidate keys) cross-product, each object's
+        ephemeral point repeated across its candidate scalars.
         """
         lib = self._require()
         if not (len(points) == 64 * n and len(scalars) == 32 * n):

@@ -92,6 +92,10 @@ class CryptoPool:
         #: (ObjectProcessor) — when not running, the per-call paths
         #: below serve
         self.batch = batch
+        #: optional negative screen (crypto/screen.py, ISSUE 17):
+        #: probed before any trial-decrypt sweep whose caller supplies
+        #: an object tag; attached by the owning ObjectProcessor
+        self.screen = None
 
     def _decrypt_fn(self):
         if self._decrypt is None:
@@ -164,6 +168,7 @@ class CryptoPool:
 
     async def try_decrypt_many(self, payload: bytes,
                                keys: Iterable[tuple[bytes, object]],
+                               *, tag: bytes | None = None,
                                ) -> list[tuple[bytes, object]]:
         """ECIES trial-decrypt ``payload`` against many candidate keys.
 
@@ -172,6 +177,14 @@ class CryptoPool:
         subscription.  Returns the (usually 0- or 1-element) list of
         ``(plaintext, handle)`` matches in submission order.
 
+        ``tag`` (the object's inventory hash) opts the sweep into the
+        negative screen (ISSUE 17): a cached no-match for the current
+        keyring epoch returns ``[]`` without paying a single ECDH, and
+        a genuinely completed no-match sweep populates the cache for
+        the next gossip re-arrival.  The probe runs BEFORE ``keys`` is
+        materialized, so callers may pass a lazy iterable and a
+        screened re-arrival stays O(1) in keyring size.
+
         First-match early-cancel: a hit sets a shared event; queued
         attempts that see it set return immediately without paying the
         ECDH+HMAC.  An object is encrypted to exactly one key, so under
@@ -179,16 +192,27 @@ class CryptoPool:
         key lands.
 
         With a running batch engine the whole sweep coalesces with
-        other objects' sweeps instead (wavefront early-exit inside the
-        engine replaces the event-based cancel).
+        other objects' sweeps instead (the engine's transposed
+        wavefront replaces the event-based cancel).
         """
+        screen, epoch = self.screen, 0
+        if screen is not None and tag is not None:
+            # capture the epoch BEFORE probing: a key added after this
+            # read voids any no-match proof this sweep could produce
+            epoch = screen.epoch
+            if screen.check(tag):
+                DECRYPT_RESULTS.labels(result="screened").inc()
+                return []
+        else:
+            tag = None          # no screen attached: record nothing
         keys = list(keys)
-        DECRYPT_FANOUT.observe(len(keys))
-        OPS.labels(op="decrypt").inc(len(keys))
         if not keys:
             return []
+        DECRYPT_FANOUT.observe(len(keys))
+        OPS.labels(op="decrypt").inc(len(keys))
         if self._batch_active():
-            matches = await self.batch.try_decrypt(payload, keys)
+            matches = await self.batch.try_decrypt(payload, keys,
+                                                   tag=tag, epoch=epoch)
             DECRYPT_RESULTS.labels(
                 result="hit" if matches else "miss").inc()
             return matches
@@ -229,6 +253,10 @@ class CryptoPool:
                        in zip(outs, keys) if out is not None]
         if skipped[0]:
             EARLY_CANCELS.inc(skipped[0])
+        if tag is not None and not matches:
+            # the per-call sweep tried every key (a ValueError is a
+            # miss, not an abort) — a genuine no-match proof
+            screen.insert(tag, epoch)
         DECRYPT_RESULTS.labels(
             result="hit" if matches else "miss").inc()
         return matches

@@ -106,8 +106,25 @@ class KeyStore:
         self.by_ripe: dict[bytes, OwnIdentity] = {}
         self.by_tag: dict[bytes, OwnIdentity] = {}
         self.subscriptions: dict[str, Subscription] = {}
+        #: keyring epoch (ISSUE 17): bumped on every identity or
+        #: subscription add/remove so trial-decrypt negative caches
+        #: know their no-match proofs are stale.  One coarse counter
+        #: covers both key sets — mutations are rare, re-sweeping a
+        #: screen's worth of objects once per mutation is cheap.
+        self.epoch = 0
+        self._listeners: list = []
         if self._path and self._path.exists():
             self.load()
+
+    def add_change_listener(self, fn) -> None:
+        """``fn()`` is called (synchronously, on the mutating thread)
+        after every keyring epoch bump."""
+        self._listeners.append(fn)
+
+    def _bump_epoch(self) -> None:
+        self.epoch += 1
+        for fn in list(self._listeners):
+            fn()
 
     # -- identity management -------------------------------------------------
 
@@ -115,6 +132,7 @@ class KeyStore:
         self.identities[ident.address] = ident
         self.by_ripe[ident.ripe] = ident
         self.by_tag[ident.tag] = ident
+        self._bump_epoch()
 
     def create_random(self, label: str = "", *, version: int = 4,
                       stream: int = 1, leading_zeros: int = 1) -> OwnIdentity:
@@ -143,17 +161,31 @@ class KeyStore:
     def owns(self, address: str) -> bool:
         return address in self.identities
 
+    def remove(self, address: str) -> OwnIdentity | None:
+        """Drop an identity and its derived-key indexes (the
+        deleteAddress/leaveChan path); bumps the keyring epoch."""
+        ident = self.identities.pop(address, None)
+        if ident is None:
+            return None
+        self.by_ripe.pop(ident.ripe, None)
+        self.by_tag.pop(ident.tag, None)
+        self._bump_epoch()
+        self.save()
+        return ident
+
     # -- subscriptions -------------------------------------------------------
 
     def subscribe(self, address: str, label: str = "") -> Subscription:
         a = decode_address(address)
         sub = Subscription(label, address, True, a.version, a.stream, a.ripe)
         self.subscriptions[address] = sub
+        self._bump_epoch()
         self.save()
         return sub
 
     def unsubscribe(self, address: str) -> None:
-        self.subscriptions.pop(address, None)
+        if self.subscriptions.pop(address, None) is not None:
+            self._bump_epoch()
         self.save()
 
     def active_subscriptions(self) -> list[Subscription]:

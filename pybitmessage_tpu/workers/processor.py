@@ -107,6 +107,7 @@ class ObjectProcessor:
                  concurrency: int = DEFAULT_CONCURRENCY,
                  write_behind: bool = True,
                  crypto_batch: bool = True,
+                 crypto_screen: bool = True,
                  flush_interval: float = DEFAULT_FLUSH_INTERVAL):
         #: UISignaler.emit-compatible callback (may be None)
         self.ui_signal = ui_signal or (lambda cmd, data=(): None)
@@ -119,6 +120,20 @@ class ObjectProcessor:
         if crypto_batch and self.crypto.batch is None:
             from ..crypto.batch import BatchCryptoEngine
             self.crypto.batch = BatchCryptoEngine()
+        #: object-keyed negative cache (ISSUE 17, docs/crypto.md):
+        #: gossip re-arrivals of proven no-match objects skip the
+        #: trial-decrypt ECDH sweep; any keystore mutation bumps the
+        #: epoch and flushes it, so a new key always gets a fresh sweep
+        if crypto_screen and self.crypto.screen is None:
+            from ..crypto.screen import NegativeScreen
+            self.crypto.screen = NegativeScreen()
+        if self.crypto.screen is not None:
+            # stub keystores (tests) may not carry the epoch plumbing
+            register = getattr(keystore, "add_change_listener", None)
+            if register is not None:
+                register(self.crypto.screen.bump)
+            if self.crypto.batch is not None:
+                self.crypto.batch.screen = self.crypto.screen
         #: write-behind: ingest-path rows coalesce into one
         #: transaction per drain (storage/writebehind.py)
         self._wb = None
@@ -460,13 +475,18 @@ class ObjectProcessor:
         # (reference decrypts every key inline on one thread,
         # objectProcessor.py:459-477 — the randomized order is kept,
         # and off-loop execution replaces decrypt-all as the timing
-        # defense: the event loop no longer times the key sweep)
-        idents = list(self.keystore.identities.values())
-        random.shuffle(idents)
+        # defense: the event loop no longer times the key sweep).
+        # Candidates stay LAZY: a screened re-arrival must not pay
+        # the O(keyring) list build + shuffle it is there to skip.
+        def _candidates():
+            idents = list(self.keystore.identities.values())
+            random.shuffle(idents)
+            for ident in idents:
+                yield ident.priv_encryption, ident
+
         with _Stage("decrypt"):
             matches = await self.crypto.try_decrypt_many(
-                encrypted, [(ident.priv_encryption, ident)
-                            for ident in idents])
+                encrypted, _candidates(), tag=h)
         if not matches:
             return
         decrypted, match = matches[0]
@@ -644,7 +664,7 @@ class ObjectProcessor:
         # keys do for msgs (v4 broadcasts trial every legacy sub key)
         with _Stage("decrypt"):
             matches = await self.crypto.try_decrypt_many(
-                encrypted, [(s.broadcast_key, s) for s in subs])
+                encrypted, [(s.broadcast_key, s) for s in subs], tag=h)
         if matches:
             LIFECYCLE.record(h, "decrypted")
         for decrypted, sub in matches:

@@ -295,13 +295,15 @@ def test_drains_coalesce_across_callers():
 
 
 def test_wavefront_stops_after_match():
-    """The decrypt sweep must not compute ECDH for candidates past the
-    first match (MAC-first wavefront early-exit)."""
+    """Transposed-drain early exit: a drain that lands a match prunes
+    the matched object's remaining candidates, so later drains never
+    compute them — and the whole sweep is ONE backend call when the
+    cross-product fits the ``drain_max`` budget."""
     if not NATIVE.available:
         pytest.skip("needs the native wavefront path")
     privs = [random_private_key() for _ in range(8)]
     pubs = [priv_to_pub(p) for p in privs]
-    payload = encrypt(b"early exit", pubs[1])   # match at round 1
+    payload = encrypt(b"early exit", pubs[1])   # match at candidate 1
     candidates = [(p, i) for i, p in enumerate(privs)]
 
     calls = []
@@ -311,8 +313,9 @@ def test_wavefront_stops_after_match():
         calls.append(n)
         return orig(n, points, scalars, nthreads=nthreads)
 
-    async def main():
-        eng = BatchCryptoEngine()
+    async def main(drain_max):
+        calls.clear()
+        eng = BatchCryptoEngine(drain_max=drain_max)
         eng.start()
         try:
             return await eng.try_decrypt(payload, candidates)
@@ -321,11 +324,18 @@ def test_wavefront_stops_after_match():
 
     NATIVE.ecdh_batch = counting
     try:
-        matches = asyncio.run(main())
+        # budget >= cross-product: the 8 candidates pack into ONE
+        # drain (vs 8 width-1 rounds pre-transposition)
+        matches = asyncio.run(main(4096))
+        assert matches == [(b"early exit", 1)]
+        assert calls == [8]
+        # budget 2: the first drain holds candidates 0-1 and lands the
+        # match; candidates 2-7 are pruned, never paying their ECDH
+        matches = asyncio.run(main(2))
+        assert matches == [(b"early exit", 1)]
+        assert calls == [2]
     finally:
         NATIVE.ecdh_batch = orig
-    assert matches == [(b"early exit", 1)]
-    assert sum(calls) == 2      # rounds 0 and 1 only, never rounds 2-7
 
 
 def test_empty_candidates_and_malformed_payload():
@@ -471,3 +481,200 @@ def test_pure_sign_verify_cross_tier():
         assert not verify(b"other", sig, pub)
     finally:
         set_native_enabled(True)
+
+
+# ---------------------------------------------------------------------------
+# transposed wavefront: 1k-vector oracle parity across rungs (ISSUE 17)
+# ---------------------------------------------------------------------------
+
+def _reference_wavefront(backend, jobs):
+    """The pre-ISSUE-17 per-round wavefront, verbatim — the semantic
+    oracle the transposed planner must match bit-for-bit: round k
+    computes ECDH for the k-th candidate of every still-unmatched
+    object in one call."""
+    from pybitmessage_tpu.crypto import ecies
+    results = [[] for _ in jobs]
+    parsed, live = [], []
+    for i, job in enumerate(jobs):
+        try:
+            pp = ecies.parse_payload(job.payload)
+        except ValueError:
+            parsed.append(None)
+            continue
+        parsed.append(pp)
+        live.append(i)
+    rnd = 0
+    while live:
+        points, scalars, idx = [], [], []
+        for i in live:
+            priv, _handle = jobs[i].candidates[rnd]
+            try:
+                scalar = priv_scalar32(priv)
+            except ValueError:
+                continue
+            points.append(parsed[i].ephem_pub[1:])
+            scalars.append(scalar)
+            idx.append(i)
+        xs = backend.ecdh_batch(len(idx), b"".join(points),
+                                b"".join(scalars), nthreads=1) \
+            if idx else []
+        nxt = set(live)
+        for i, x in zip(idx, xs):
+            if x is None:
+                continue
+            pp = parsed[i]
+            key_e, key_m = ecies.kdf(x)
+            if not ecies.mac_ok(key_m, pp.macdata, pp.tag):
+                continue
+            try:
+                plain = ecies.finish_decrypt(key_e, pp)
+            except ValueError:
+                continue
+            results[i].append((plain, jobs[i].candidates[rnd][1]))
+            nxt.discard(i)
+        rnd += 1
+        live = [i for i in nxt if rnd < len(jobs[i].candidates)]
+    return results
+
+
+def _mac_valid_unpaddable(recipient_pub):
+    """An adversarial payload whose MAC verifies under the recipient
+    key but whose plaintext padding is invalid — the sweep must treat
+    it as a miss AFTER paying the AES, not crash or mis-settle."""
+    from pybitmessage_tpu.crypto import ecies
+    from pybitmessage_tpu.crypto.ecies import encode_pubkey_wire
+    ephem = random_private_key()
+    key_e, key_m = ecies.kdf(ecies.ecdh_raw(ephem, recipient_pub))
+    iv = os.urandom(16)
+    # raw CBC over a block whose final pad byte is 0 -> unpad rejects
+    ct = fallback.aes256_cbc(True, key_e, iv, os.urandom(31) + b"\x00")
+    blob = iv + encode_pubkey_wire(priv_to_pub(ephem)) + ct
+    import hashlib
+    import hmac as hmac_mod
+    mac = hmac_mod.new(key_m, blob, hashlib.sha256).digest()
+    return blob + mac
+
+
+def _oracle_jobs(n_objects=50, n_cands=20, seed=20260807):
+    """~1k (object x candidate) pairs with planted adversarial
+    entries: invalid scalars (zero / out-of-range), a duplicated
+    candidate key under a different handle, malformed payloads, and a
+    MAC-valid-but-unpaddable forgery."""
+    import random as _random
+
+    from pybitmessage_tpu.crypto.batch import _DecryptJob
+    rng = _random.Random(seed)
+    privs = [random_private_key() for _ in range(n_cands)]
+    pubs = [priv_to_pub(p) for p in privs]
+    match_slots = [m for m in (0, 1, 2, 5, 9, 15, 19) if m < n_cands]
+    jobs = []
+    for i in range(n_objects):
+        cands = [(privs[j], j) for j in range(n_cands)]
+        if n_cands > 11:
+            cands[3] = (b"\x00" * 32, "zero")       # scalar 0: invalid
+            cands[11] = (b"\xff" * 32, "oob")       # >= n: invalid
+            cands[7] = (privs[5], "dup5")           # duplicate key
+        kind = i % 10
+        if kind < 6:        # common case: matches no local key
+            payload = encrypt(b"miss %d" % i,
+                              priv_to_pub(random_private_key()))
+        elif kind < 8:      # a real match at a random candidate slot
+            m = rng.choice(match_slots)
+            payload = encrypt(b"hit %d" % i, pubs[m])
+        elif kind == 8:     # malformed: parse_payload must reject
+            payload = os.urandom(40) if i % 2 else b""
+        else:               # MAC passes, padding does not
+            payload = _mac_valid_unpaddable(pubs[2])
+        jobs.append(_DecryptJob(payload, cands, None))
+    return jobs
+
+
+@needs_native
+def test_transposed_parity_oracle_native():
+    """Acceptance: the transposed planner is bit-identical to the old
+    per-round wavefront on a ~1k-pair vector, across drain budgets
+    that cut drains mid-pass, per-pass and not at all."""
+    jobs = _oracle_jobs()
+    want = _reference_wavefront(NATIVE, jobs)
+    assert sum(1 for r in want if r) == 10          # the planted hits
+    for drain_max in (7, 64, 4096):
+        eng = BatchCryptoEngine(drain_max=drain_max)
+        assert eng._backend_decrypt(NATIVE, jobs) == want
+    # duplicate-key adversarial entry: the EARLIER duplicate wins
+    assert all(h != "dup5" for r in want for _, h in r)
+
+
+@needs_native
+def test_transposed_parity_oracle_pure():
+    """The pure rung (per-object sweep) answers identically to the
+    batch oracle — drain failures that land there lose nothing."""
+    jobs = _oracle_jobs(n_objects=10)
+    want = _reference_wavefront(NATIVE, jobs)
+    eng = BatchCryptoEngine(use_native=False)
+    assert eng._pure_decrypt(jobs) == want
+
+
+@pytest.mark.slow       # first-launch XLA compile of the wide buckets
+def test_transposed_parity_oracle_tpu():
+    """Acceptance: same oracle through the accelerator rung (XLA path
+    on CPU CI), transposed drains wide enough to use the top lane
+    bucket."""
+    from pybitmessage_tpu.crypto import tpu as crypto_tpu
+    crypto_tpu.configure("on")
+    crypto_tpu.set_tpu_enabled(True)
+    crypto_tpu.reset_tpu()
+    try:
+        rung = crypto_tpu.get_tpu()
+        if not rung.available:
+            pytest.skip("tpu rung unavailable: %s"
+                        % rung.snapshot().get("reason"))
+        jobs = _oracle_jobs()
+        want = _reference_wavefront(rung, jobs)
+        eng = BatchCryptoEngine(drain_max=4096)
+        assert eng._backend_decrypt(rung, jobs) == want
+        if NATIVE.available:
+            assert _reference_wavefront(NATIVE, jobs) == want
+    finally:
+        crypto_tpu.configure("auto")
+        crypto_tpu.set_tpu_enabled(True)
+        crypto_tpu.reset_tpu()
+
+
+def test_tpu_gate_counts_candidate_pairs():
+    """The launch-worthiness gate judges the EFFECTIVE fan (verify
+    checks + ECDH pairs): 2 objects x 40 keys clears a floor of 64;
+    2 objects x 10 keys does not (the old object-count gate refused
+    both)."""
+    from pybitmessage_tpu.crypto.batch import _DecryptJob
+    privs = [random_private_key() for _ in range(40)]
+    payload = encrypt(b"gate", priv_to_pub(random_private_key()))
+
+    def probe(n_cands):
+        eng = BatchCryptoEngine(use_tpu=True, tpu_batch_min=64)
+        consulted = []
+        eng._tpu_engine = lambda: consulted.append(1) and None
+        jobs = [_DecryptJob(payload,
+                            [(p, i) for i, p in enumerate(privs[:n_cands])],
+                            None) for _ in range(2)]
+        eng._execute([], jobs)
+        return bool(consulted)
+
+    assert probe(40)            # 80 pairs >= 64: consult the tpu rung
+    assert not probe(10)        # 20 pairs < 64: start at native
+
+
+@needs_native
+def test_drain_budget_shapes_and_counters():
+    """cryptodrainmax caps every drain; the engine's drain-shape
+    attributes (clientStatus) and width histogram see every launch."""
+    from pybitmessage_tpu.crypto.batch import _DecryptJob
+    privs = [random_private_key() for _ in range(50)]
+    cands = [(p, i) for i, p in enumerate(privs)]
+    jobs = [_DecryptJob(encrypt(b"w%d" % i,
+                                priv_to_pub(random_private_key())),
+                        cands, None) for i in range(4)]
+    eng = BatchCryptoEngine(drain_max=64)
+    eng._backend_decrypt(NATIVE, jobs)
+    # 4 objects x 50 keys = 200 pairs -> 64+64+64+8
+    assert eng.drains == 4
+    assert eng.drain_pairs == 200

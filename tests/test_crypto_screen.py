@@ -1,0 +1,317 @@
+"""Negative trial-decrypt screen tests (ISSUE 17).
+
+The object-keyed negative cache must NEVER cause a false negative: a
+cached "matches nothing" proof is only valid for the exact keyring
+epoch whose sweep produced it, only written by sweeps that genuinely
+tried every candidate, and flushed the moment any identity or
+subscription changes.  These tests pin each of those rules, the
+bounded-LRU behavior, the keystore epoch plumbing, the processor
+wiring, and the chaos property (rung failures at ``crypto.tpu`` /
+``crypto.native`` lose no matches and poison no cache entries).
+"""
+
+import asyncio
+import os
+
+import pytest
+
+from pybitmessage_tpu.crypto import (
+    encrypt, priv_to_pub, random_private_key,
+)
+from pybitmessage_tpu.crypto.batch import BatchCryptoEngine
+from pybitmessage_tpu.crypto.native import get_native
+from pybitmessage_tpu.crypto.screen import NegativeScreen
+from pybitmessage_tpu.observability import REGISTRY
+from pybitmessage_tpu.resilience import CHAOS
+from pybitmessage_tpu.workers.cryptopool import CryptoPool
+
+NATIVE = get_native()
+needs_native = pytest.mark.skipif(
+    not NATIVE.available, reason="native secp256k1 library unbuilt")
+
+
+def _sample(name, labels=None):
+    return REGISTRY.sample(name, labels) or 0.0
+
+
+# ---------------------------------------------------------------------------
+# NegativeScreen unit behavior
+# ---------------------------------------------------------------------------
+
+def test_screen_check_insert_and_counters():
+    s = NegativeScreen(capacity=16)
+    hits = _sample("crypto_screen_hits_total")
+    misses = _sample("crypto_screen_misses_total")
+    assert not s.check(b"t1")
+    assert s.insert(b"t1", s.epoch)
+    assert s.check(b"t1")
+    assert _sample("crypto_screen_hits_total") == hits + 1
+    assert _sample("crypto_screen_misses_total") == misses + 1
+
+
+def test_screen_lru_eviction_is_bounded():
+    s = NegativeScreen(capacity=4)
+    for i in range(5):
+        s.insert(b"tag%d" % i, 0)
+    assert len(s) == 4
+    assert not s.check(b"tag0")         # oldest proof evicted
+    assert s.check(b"tag4")
+    # a check refreshes LRU position: tag1 survives the next insert
+    s.check(b"tag1")
+    s.insert(b"tag5", 0)
+    assert s.check(b"tag1")
+    assert not s.check(b"tag2")
+
+
+def test_screen_stale_epoch_insert_dropped():
+    """A sweep that began under an older keyring epoch proves nothing
+    about the current keyring — its no-match write must be dropped."""
+    s = NegativeScreen()
+    epoch_at_sweep_start = s.epoch
+    s.bump()                            # key added mid-sweep
+    assert not s.insert(b"stale", epoch_at_sweep_start)
+    assert len(s) == 0
+    assert s.insert(b"fresh", s.epoch)
+
+
+def test_screen_bump_flushes_and_counts():
+    s = NegativeScreen()
+    s.insert(b"a", 0)
+    s.insert(b"b", 0)
+    inv = _sample("crypto_screen_invalidations_total")
+    s.bump()
+    assert s.epoch == 1 and len(s) == 0
+    assert _sample("crypto_screen_invalidations_total") == inv + 1
+    snap = s.snapshot()
+    assert snap["entries"] == 0 and snap["epoch"] == 1
+    assert snap["capacity"] == s.capacity
+
+
+# ---------------------------------------------------------------------------
+# keystore epoch plumbing
+# ---------------------------------------------------------------------------
+
+def test_keystore_mutations_bump_screen_epoch(tmp_path):
+    """Every identity/subscription add AND remove invalidates: a
+    cached no-match must be re-swept once the keyring changes in any
+    direction (an added key might decrypt it; a removed one changes
+    what 'swept everything' meant)."""
+    from pybitmessage_tpu.workers.keystore import KeyStore
+    ks = KeyStore(tmp_path / "keys.json")
+    screen = NegativeScreen()
+    ks.add_change_listener(screen.bump)
+
+    def bumps(fn):
+        before = screen.epoch
+        screen.insert(b"proof", before)
+        out = fn()
+        changed = screen.epoch != before
+        if changed:
+            assert len(screen) == 0     # bump flushes the table
+        return changed, out
+
+    changed, ident = bumps(lambda: ks.create_random("id"))
+    assert changed
+    changed, sub = bumps(lambda: ks.subscribe(ident.address, "self"))
+    assert changed
+    changed, _ = bumps(lambda: ks.unsubscribe(ident.address))
+    assert changed
+    # no-op mutations must NOT flush the cache
+    changed, _ = bumps(lambda: ks.unsubscribe("BM-nonexistent"))
+    assert not changed
+    changed, _ = bumps(lambda: ks.remove("BM-nonexistent"))
+    assert not changed
+    changed, removed = bumps(lambda: ks.remove(ident.address))
+    assert changed and removed is ident
+    assert ks.get(ident.address) is None
+
+
+def test_processor_wires_screen_to_keystore(tmp_path):
+    """ObjectProcessor attaches one screen to the pool AND the batch
+    engine and registers the keystore listener; crypto_screen=False
+    opts out."""
+    from types import SimpleNamespace
+
+    from pybitmessage_tpu.workers.keystore import KeyStore
+    from pybitmessage_tpu.workers.processor import ObjectProcessor
+
+    class _Store:
+        def pop_objectprocessor_queue(self):
+            return []
+
+    ks = KeyStore(tmp_path / "keys.json")
+    proc = ObjectProcessor(
+        keystore=ks, store=_Store(), inventory=None,
+        sender=SimpleNamespace(), write_behind=False)
+    screen = proc.crypto.screen
+    assert screen is not None
+    assert proc.crypto.batch.screen is screen
+    epoch = screen.epoch
+    ks.create_random("wired")
+    assert screen.epoch == epoch + 1
+
+    off = ObjectProcessor(
+        keystore=KeyStore(tmp_path / "keys2.json"), store=_Store(),
+        inventory=None, sender=SimpleNamespace(), write_behind=False,
+        crypto_batch=False, crypto_screen=False)
+    assert off.crypto.screen is None
+
+
+# ---------------------------------------------------------------------------
+# pool integration: probe, populate, never a false negative
+# ---------------------------------------------------------------------------
+
+def _pool_with_screen(size=0):
+    pool = CryptoPool(size)
+    pool.screen = NegativeScreen()
+    return pool
+
+
+def test_pool_screen_caches_only_completed_no_match():
+    """Per-call path: a completed no-match sweep populates the screen,
+    a re-arrival is screened without any crypto ops, and a keyring
+    bump re-opens the sweep so the new key's match is found."""
+    pool = _pool_with_screen()
+    priv = random_private_key()
+    payload = encrypt(b"secret", priv_to_pub(priv))
+    foreign = [(random_private_key(), i) for i in range(4)]
+    tag = os.urandom(32)
+
+    async def sweep(keys):
+        return await pool.try_decrypt_many(payload, keys, tag=tag)
+
+    assert asyncio.run(sweep(foreign)) == []
+    assert pool.screen.check(tag)       # no-match proof recorded
+
+    ops = _sample("crypto_pool_ops_total", {"op": "decrypt"})
+    screened = _sample("crypto_decrypt_total", {"result": "screened"})
+    assert asyncio.run(sweep(foreign)) == []
+    assert _sample("crypto_pool_ops_total", {"op": "decrypt"}) == ops
+    assert _sample("crypto_decrypt_total",
+                   {"result": "screened"}) == screened + 1
+
+    # the matching key arrives: epoch bump voids the proof, the next
+    # sweep runs for real and finds it — zero false negatives
+    pool.screen.bump()
+    matches = asyncio.run(sweep(foreign + [(priv, "me")]))
+    assert [h for _, h in matches] == ["me"]
+    assert not pool.screen.check(tag)   # matches are never cached
+
+
+def test_pool_screen_ignores_sweeps_without_tag():
+    pool = _pool_with_screen()
+    payload = encrypt(b"x", priv_to_pub(random_private_key()))
+    out = asyncio.run(pool.try_decrypt_many(
+        payload, [(random_private_key(), 0)]))
+    assert out == []
+    assert len(pool.screen) == 0
+
+
+def test_engine_shutdown_settlement_never_inserts():
+    """The engine's conservative settlements (shutdown, drain failure)
+    resolve 'no match' WITHOUT sweeping every candidate — they must
+    not mint no-match proofs."""
+    from pybitmessage_tpu.crypto.batch import _DecryptJob
+
+    eng = BatchCryptoEngine()
+    eng.screen = NegativeScreen()
+    job = _DecryptJob(
+        encrypt(b"x", priv_to_pub(random_private_key())),
+        [(random_private_key(), 0)],
+        None, tag=os.urandom(32), epoch=0)
+
+    class _Fut:
+        def done(self):
+            return False
+
+        def set_result(self, value):
+            self.value = value
+
+    job.fut = _Fut()
+    eng._settle(job)
+    assert job.fut.value == []
+    assert len(eng.screen) == 0
+
+
+# ---------------------------------------------------------------------------
+# chaos: rung failures lose nothing and poison nothing
+# ---------------------------------------------------------------------------
+
+def _chaos_sweeps(pool):
+    """6 objects: 2 real matches, 4 misses, swept through the pool's
+    batch path with tags.  Returns (results, screen tag-set)."""
+    privs = [random_private_key() for _ in range(8)]
+    cands = [(p, i) for i, p in enumerate(privs)]
+    vectors = []
+    for i in range(6):
+        if i < 2:
+            payload = encrypt(b"hit %d" % i, priv_to_pub(privs[3 + i]))
+        else:
+            payload = encrypt(b"miss %d" % i,
+                              priv_to_pub(random_private_key()))
+        vectors.append((payload, bytes([i]) * 32))
+
+    async def run_all():
+        eng = pool.batch
+        eng.start()
+        try:
+            return await asyncio.gather(
+                *[pool.try_decrypt_many(pl, cands, tag=t)
+                  for pl, t in vectors])
+        finally:
+            await eng.stop()
+
+    results = asyncio.run(run_all())
+    cached = {t for _, t in vectors if pool.screen.check(t)}
+    return results, cached
+
+
+def _fresh_batch_pool(**engine_kw):
+    pool = CryptoPool(0, batch=BatchCryptoEngine(**engine_kw))
+    pool.screen = NegativeScreen()
+    pool.batch.screen = pool.screen
+    return pool
+
+
+@needs_native
+def test_screen_chaos_native_zero_loss_zero_false_negatives():
+    clean, clean_cached = _chaos_sweeps(_fresh_batch_pool())
+    assert [h for r in clean[:2] for _, h in r] == [3, 4]
+    assert all(r == [] for r in clean[2:])
+
+    CHAOS.seed(1234)
+    CHAOS.arm("crypto.native", probability=1.0)
+    try:
+        chaotic, chaos_cached = _chaos_sweeps(_fresh_batch_pool())
+    finally:
+        CHAOS.disarm()
+    assert chaotic == clean             # zero loss through the pure rung
+    # the pure rung's completed sweeps still populate the screen, and
+    # ONLY with genuine no-matches (never a matched object's tag)
+    assert chaos_cached == clean_cached
+    assert len(chaos_cached) == 4
+
+
+def test_screen_chaos_tpu_zero_loss_zero_false_negatives():
+    """Chaos at the accelerator rung: the fallback ladder answers
+    identically and the screen is populated by whichever rung
+    completed, never by the failed launch."""
+    from pybitmessage_tpu.crypto import tpu as crypto_tpu
+    crypto_tpu.configure("on")
+    crypto_tpu.set_tpu_enabled(True)
+    crypto_tpu.reset_tpu()
+    CHAOS.seed(99)
+    CHAOS.arm("crypto.tpu", probability=1.0)
+    try:
+        # tpu_batch_min=1 so every drain consults the tpu rung (and
+        # hits the armed chaos site before any device work)
+        chaotic, cached = _chaos_sweeps(
+            _fresh_batch_pool(use_tpu=True, tpu_batch_min=1))
+    finally:
+        CHAOS.disarm()
+        crypto_tpu.configure("auto")
+        crypto_tpu.set_tpu_enabled(True)
+        crypto_tpu.reset_tpu()
+    assert [h for r in chaotic[:2] for _, h in r] == [3, 4]
+    assert all(r == [] for r in chaotic[2:])
+    assert len(cached) == 4

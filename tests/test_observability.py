@@ -683,6 +683,34 @@ def test_perfguard_missing_metric_is_a_regression():
     assert not failures and any(n.startswith("SKIP") for n in notes)
 
 
+def test_perfguard_env_scale_scales_floors_down_only():
+    """Calibration-aware bands (ISSUE 17 satellite): a slower host
+    than the baseline recorder gets its wall-clock 'higher' floors
+    scaled down by the measured speed ratio; a faster host never gets
+    a ratcheted-up bar; runs without the stamp compare neutrally."""
+    bc = _bench_compare()
+    base = {"calibration": {"cpu_count": 24,
+                            "single_thread_hps": 1000.0},
+            "rate": 100.0}
+    guards = [("rate", "higher", 0.60)]
+    slow = {"calibration": {"cpu_count": 1,
+                            "single_thread_hps": 500.0},
+            "rate": 15.0}
+    scale = bc.env_scale(base, slow)
+    assert 0.05 <= scale < 0.5
+    failures, notes = bc.compare(base, slow, guards)
+    assert not failures, failures
+    assert any("host x" in n for n in notes)
+    # without scaling this run would have failed the 40-point floor
+    assert slow["rate"] < base["rate"] * 0.40
+    fast = {"calibration": {"cpu_count": 48,
+                            "single_thread_hps": 2000.0},
+            "rate": 41.0}
+    assert bc.env_scale(base, fast) == 1.0
+    assert bc.env_scale({}, slow) == 1.0          # no stamp: neutral
+    assert bc.env_scale(base, {}) == 1.0
+
+
 def test_perfguard_committed_baseline_is_consistent():
     """The committed smoke baseline must parse and carry at least the
     machine-independent invariant guards (the 'equal' kind) so

@@ -132,6 +132,24 @@ GUARDS: list[tuple[str, str, float]] = [
     ("configs.ingest_storm.wide_host.objects_per_s", "higher", 0.60),
     ("configs.ingest_storm.wide_host.zero_objects_lost",
      "equal", 0.0),
+    # keyring-scaling sweep (ISSUE 17): warm re-arrival throughput
+    # must stay >= 0.5x across two orders of magnitude of keyring
+    # growth (the negative screen removes the keyring dimension from
+    # the gossip re-flood path), the screen must actually serve the
+    # warm rounds, a cached no-match may NEVER eat a real match, and
+    # the transposed drains must stay wide enough to earn the tpu
+    # rung's launch floor (cryptotpubatchmin=64) — all machine-
+    # independent ratios/invariants, so absolute bars, not bands
+    ("configs.ingest_storm.keyring_sweep.flatness_ratio",
+     "atleast", 0.5),
+    ("configs.ingest_storm.keyring_sweep.screen_hit_rate",
+     "atleast", 0.9),
+    ("configs.ingest_storm.keyring_sweep.zero_false_negatives",
+     "equal", 1.0),
+    ("configs.ingest_storm.keyring_sweep.zero_objects_lost",
+     "equal", 1.0),
+    ("configs.ingest_storm.keyring_sweep.mean_drain_width",
+     "atleast", 64.0),
     # continuous profiling plane (ISSUE 15): the sampler's own cost
     # must stay far under the 2% budget (absolute ceiling — the same
     # bar make profile-smoke asserts), and the wide-host attribution
@@ -191,11 +209,39 @@ def section_skipped(d: dict, path: str) -> bool:
     return isinstance(cur, dict) and "skipped" in cur
 
 
+def env_scale(baseline: dict, current: dict) -> float:
+    """Host-speed scale for wall-clock "higher" floors (ISSUE 17
+    satellite): both runs stamp a ``calibration`` block (cpu count +
+    a fixed single-thread hash rate); when the current host is slower
+    than the one that recorded the baseline, its throughput floors
+    scale DOWN by the measured ratio.  Never scales up (a faster host
+    must still only meet the recorded floor — CI should not ratchet),
+    never below 0.05 (a 20x-slower host still has to produce numbers),
+    and defaults to 1.0 when either run lacks the stamp (old
+    baselines, unit-test fixtures)."""
+    b = baseline.get("calibration") or {}
+    c = current.get("calibration") or {}
+    try:
+        st = float(c["single_thread_hps"]) / float(b["single_thread_hps"])
+        cores = float(c["cpu_count"]) / float(b["cpu_count"])
+    except (KeyError, TypeError, ValueError, ZeroDivisionError):
+        return 1.0
+    if st <= 0 or cores <= 0:
+        return 1.0
+    # single-thread speed dominates; losing cores hurts the parallel
+    # benches roughly as sqrt (they are not perfectly parallel)
+    return max(0.05, min(1.0, st * min(1.0, cores) ** 0.5))
+
+
 def compare(baseline: dict, current: dict,
             guards=GUARDS) -> tuple[list[str], list[str]]:
     """Returns (failures, notes) — empty failures means the run holds
     the baseline."""
     failures, notes = [], []
+    scale = env_scale(baseline, current)
+    if scale != 1.0:
+        notes.append("NOTE  host slower than baseline recorder: "
+                     "wall-clock floors scaled x%.3f" % scale)
     for path, kind, tol in guards:
         base = dig(baseline, path)
         if base is None:
@@ -239,10 +285,11 @@ def compare(baseline: dict, current: dict,
                             % (path, cur, base))
             continue
         if kind == "higher":
-            floor = base_f * (1.0 - tol)
+            floor = base_f * (1.0 - tol) * scale
             ok = cur_f >= floor
-            detail = "%.4g >= %.4g (baseline %.4g - %d%%)" % (
-                cur_f, floor, base_f, tol * 100)
+            detail = "%.4g >= %.4g (baseline %.4g - %d%%%s)" % (
+                cur_f, floor, base_f, tol * 100,
+                ", host x%.3f" % scale if scale != 1.0 else "")
         else:
             ceil = base_f * (1.0 + tol)
             ok = cur_f <= ceil
@@ -299,6 +346,10 @@ def main(argv=None) -> int:
             "tool": "tools/bench_compare.py --update",
             "kernel": current.get("kernel"),
             "smoke": current.get("smoke", False)}}
+        # the host-speed stamp rides the baseline so compare() can
+        # scale wall-clock floors on slower machines (env_scale)
+        if current.get("calibration"):
+            slim["calibration"] = current["calibration"]
         for path, _, _ in GUARDS:
             val = dig(current, path)
             if val is None:
