@@ -29,6 +29,8 @@ bench: native
 
 # seeded chaos suite on the CPU mesh (docs/resilience.md): fault
 # injection at pow.device_launch / pow.readback / db.write / net.send
+# plus the role fabric (role.ipc / role.handoff / role.replica —
+# relay kill/restart and mid-drain handoff receiver kill/restart)
 # proving no-object-loss + checkpoint resume; stays in the tier-1
 # "not slow" budget
 chaos: native

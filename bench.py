@@ -2579,6 +2579,10 @@ def _bench_role_split(objects: int = 12000, edges: int = 4,
         "split deployment lost %d objects" % split["lost"])
     assert fused["clean_shutdown"] and split["clean_shutdown"], \
         "a role process did not exit cleanly on SIGTERM"
+    # elastic shard fabric drill (ISSUE 18): replicas, live split
+    # under load, kill-a-relay-under-load failover — same wire path,
+    # one deployment, three measured phases
+    out["rescale"] = _bench_role_rescale(smoke=smoke)
     if not smoke:
         floor = float(os.environ.get("BMTPU_ROLE_RATE_FLOOR", "2.0"))
         out["rate_floor"] = floor
@@ -2586,6 +2590,220 @@ def _bench_role_split(objects: int = 12000, edges: int = 4,
             "split/fused ratio %.2f below the %.1fx floor (%d edges)"
             % (ratio, floor, edges))
     return out
+
+
+def _bench_role_rescale(smoke: bool = False) -> dict:
+    """Rescale under load (ISSUE 18 tentpole): one deployment of real
+    daemon subprocesses, three measured phases.
+
+    Phase 1 (baseline) — relay A owns streams 1+2, relay A2 replicates
+    stream 1 (edges fan stream-1 records to both, actively): flood,
+    measure end-to-end accepted obj/s.  Phase 2 (split under load) —
+    spawn relay B mid-run and ``shardShed`` stream 2 from A to B WHILE
+    the flood is in flight: the bucket drain, the mid-drain
+    shadow-forward, and the edges' SHARD_UPDATE re-route all race live
+    traffic.  Phase 3 (kill a relay under load) — SIGKILL A mid-flood:
+    stream 1 fails over to replica A2 (unacked frames requeue and
+    reroute), stream 2 already lives on B.
+
+    Zero loss is the hard bar: after phase 3 the SURVIVORS hold every
+    flooded object (A2 all of stream 1, B all of stream 2).  Clean
+    SIGTERM shutdown is asserted for every process except the
+    deliberately murdered primary.  Full mode additionally asserts the
+    post-split rate did not collapse (``BMTPU_RESCALE_STEP_FLOOR``,
+    default 0.8x the replicated baseline — on a multi-core host the
+    split halves A's ingest load, so well above 1x is expected; the
+    smoke floor lives in tools/bench_compare.py)."""
+    import asyncio
+    import signal
+    import subprocess
+
+    # smoke phases are sized so each measured wall comfortably clears
+    # the 50 ms convergence-poll quantum (rates stay band-guardable)
+    n_phase, clients, edge_procs = (300, 2, 1) if smoke else (2500, 8, 2)
+    timeout_s = 120.0 if smoke else 420.0
+    half = n_phase // 2
+    t0 = time.perf_counter()
+    floods = []
+    for _ in range(3):
+        s1 = _build_relay_objects(half, stream=1)
+        s2 = _build_relay_objects(half, stream=2)
+        floods.append([p for pair in zip(s1, s2) for p in pair])
+    build_s = time.perf_counter() - t0
+
+    p2p_port = _free_port()
+    ipc_a, ipc_a2, ipc_b = _free_port(), _free_port(), _free_port()
+    api_a, api_a2, api_b = _free_port(), _free_port(), _free_port()
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    here = os.path.dirname(os.path.abspath(__file__))
+
+    def spawn(args):
+        return subprocess.Popen(
+            [sys.executable, "-m", "pybitmessage_tpu", "-t", "--no-udp",
+             "--api-user", "bench", "--api-password", "bench"] + args,
+            env=env, cwd=here, stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL)
+
+    def spawn_relay(api_port, ipc_port, streams):
+        return spawn(["-p", "0", "--api-port", str(api_port),
+                      "--set", "role=relay",
+                      "--set", "rolestreams=%s" % streams,
+                      "--set", "roleipclisten=127.0.0.1:%d" % ipc_port,
+                      "--set", "inventorystorage=slab"])
+
+    def status(port):
+        return json.loads(_role_rpc(port, "roleStatus"))
+
+    procs = []
+    proc_a = None
+    try:
+        proc_a = spawn_relay(api_a, ipc_a, "1,2")    # primary
+        proc_a2 = spawn_relay(api_a2, ipc_a2, "1")   # stream-1 replica
+        procs += [proc_a, proc_a2]
+        # B sits in every edge's connect list from the start; its link
+        # simply stays on the health ladder's bottom rung (and keeps
+        # redialing) until phase 2 spawns it — adopting a new relay
+        # needs no edge restart
+        connect = ",".join("127.0.0.1:%d" % p
+                           for p in (ipc_a, ipc_a2, ipc_b))
+        for _ in range(edge_procs):
+            procs.append(spawn(
+                ["-p", str(p2p_port), "--no-api",
+                 "--set", "role=edge",
+                 "--set", "rolestreams=1,2",
+                 "--set", "edgeprocs=%d" % edge_procs,
+                 "--set", "roleipcconnect=%s" % connect]))
+
+        def wait_ready(api_ports):
+            deadline = time.time() + 120
+            while True:
+                if time.time() > deadline:
+                    raise RuntimeError(
+                        "rescale deployment never became ready")
+                for p in procs:
+                    if p.poll() is not None:
+                        raise RuntimeError(
+                            "rescale process died during start")
+                try:
+                    if all(len(status(p)["ipc"]["edges"]) == edge_procs
+                           for p in api_ports):
+                        return
+                except (OSError, RuntimeError, KeyError):
+                    pass
+                time.sleep(0.2)
+
+        wait_ready([api_a, api_a2])
+
+        async def drive():
+            conns = [await _RoleWireClient().connect(p2p_port)
+                     for _ in range(clients)]
+
+            async def flood(payloads):
+                share = (len(payloads) + clients - 1) // clients
+                await asyncio.gather(*(
+                    c.send_objects(payloads[i * share:(i + 1) * share])
+                    for i, c in enumerate(conns)))
+
+            async def converge(expect, t_start):
+                got = {}
+                deadline = time.perf_counter() + timeout_s
+                while time.perf_counter() < deadline:
+                    got = await asyncio.to_thread(
+                        lambda: {p: status(p)["inventoryObjects"]
+                                 for p in expect})
+                    if all(got[p] >= expect[p] for p in expect):
+                        return time.perf_counter() - t_start
+                    await asyncio.sleep(0.05)
+                raise RuntimeError("rescale flood never converged: "
+                                   "%r < %r" % (got, expect))
+
+            def rate(n, wall):
+                return {"objects": n, "wall_s": round(wall, 3),
+                        "objects_per_s": round(n / max(wall, 1e-9), 1)}
+
+            out = {}
+            # phase 1 — replicated baseline: A ingests both streams,
+            # A2 actively replicates stream 1
+            t = time.perf_counter()
+            await flood(floods[0])
+            out["baseline"] = rate(n_phase, await converge(
+                {api_a: n_phase, api_a2: half}, t))
+
+            # phase 2 — live split UNDER LOAD: spawn B, then shed
+            # stream 2 from A to B while the flood is in flight
+            procs.append(spawn_relay(api_b, ipc_b, "3"))
+            await asyncio.to_thread(wait_ready, [api_b])
+            t = time.perf_counter()
+            send = asyncio.ensure_future(flood(floods[1]))
+            out["handoff"] = json.loads(await asyncio.to_thread(
+                _role_rpc, api_a, "shardShed", 2,
+                "127.0.0.1:%d" % ipc_b))
+            await send
+            out["split"] = rate(n_phase, await converge(
+                {api_a2: 2 * half, api_b: 2 * half}, t))
+
+            # phase 3 — kill the primary mid-flood: stream 1 fails
+            # over to A2, stream 2 already lives on B
+            t = time.perf_counter()
+            send = asyncio.ensure_future(flood(floods[2]))
+            await asyncio.sleep(0.05 if smoke else 0.5)
+            proc_a.kill()
+            await send
+            out["failover"] = rate(n_phase, await converge(
+                {api_a2: 3 * half, api_b: 3 * half}, t))
+            for c in conns:
+                await c.close()
+            return out
+
+        result = asyncio.run(drive())
+
+        clean = True
+        for p in procs:
+            if p is not proc_a:
+                p.send_signal(signal.SIGTERM)
+        for p in procs:
+            if p is proc_a:
+                p.wait(timeout=30)   # reap the SIGKILLed primary
+                continue
+            try:
+                clean = (p.wait(timeout=30) == 0) and clean
+            except subprocess.TimeoutExpired:
+                clean = False
+                p.kill()
+                p.wait()
+
+        ratio = round(result["split"]["objects_per_s"]
+                      / max(result["baseline"]["objects_per_s"],
+                            1e-9), 2)
+        out = {
+            "objects": 3 * n_phase,
+            "clients": clients,
+            "edges": edge_procs,
+            "build_s": round(build_s, 2),
+            "baseline": result["baseline"],
+            "split": result["split"],
+            "failover": result["failover"],
+            "handoff": result["handoff"],
+            "step_up_ratio": ratio,
+            # converge() raises on any shortfall, so reaching here
+            # means the survivors hold every flooded object
+            "zero_objects_lost": 0,
+            "clean_shutdown": clean,
+        }
+        assert clean, "a rescale process did not exit cleanly on SIGTERM"
+        if not smoke:
+            floor = float(os.environ.get("BMTPU_RESCALE_STEP_FLOOR",
+                                         "0.8"))
+            out["step_floor"] = floor
+            assert ratio >= floor, (
+                "post-split rate %.2fx the replicated baseline, below "
+                "the %.1fx floor" % (ratio, floor))
+        return out
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
 
 
 def _bench_sync_storm(peers: int = 8, objects: int = 10000,

@@ -764,6 +764,49 @@ class CommandHandler:
             out["ipc"] = runtime.snapshot()
         return json.dumps(out, indent=4)
 
+    def cmd_shardStatus(self):
+        """Elastic shard fabric status (docs/roles.md): this node's
+        shard-map epoch, owned streams, and the role runtime's view —
+        an edge's per-stream replica sets with health-ladder rungs and
+        per-link epochs, a relay's forwarding table and connected
+        edges.  The rescale bench and the failover runbook poll this
+        to watch a split/merge or a kill-switch drill converge."""
+        node = self.node
+        runtime = getattr(node, "role_runtime", None)
+        out = {
+            "role": getattr(node, "role", "all"),
+            "streams": list(node.ctx.streams),
+            "epoch": getattr(runtime, "epoch", 0),
+            "inventoryObjects": len(node.inventory),
+        }
+        if runtime is not None:
+            out["ipc"] = runtime.snapshot()
+        return json.dumps(out, indent=4)
+
+    async def cmd_shardShed(self, stream, target):
+        """Relay only: live-hand ``stream`` off to the relay at
+        ``target`` (``host:port`` of its role-IPC listener) — drain
+        the stream's expiry buckets over acked OBJECTS frames, shed
+        it, SHARD_UPDATE every edge, and enter forwarding mode
+        (docs/roles.md "Live split/merge").  Safe to re-invoke after
+        an interruption; returns drain counts and the new epoch."""
+        runtime = getattr(self.node, "role_runtime", None)
+        shed = getattr(runtime, "shed_stream", None)
+        if shed is None:
+            raise APIError(0, "shardShed requires the relay role")
+        try:
+            stream = int(stream)
+        except (TypeError, ValueError):
+            raise APIError(0, "stream must be an integer")
+        try:
+            result = await shed(stream, str(target))
+        except ValueError as exc:
+            raise APIError(0, str(exc))
+        except (OSError, ConnectionError, asyncio.TimeoutError) as exc:
+            raise APIError(0, "handoff to %s failed (re-invoke to "
+                           "resume): %r" % (target, exc))
+        return json.dumps(result)
+
     def cmd_dumpFlightRecorder(self, kind=""):
         """Dump the flight-recorder ring (ISSUE 6): the last N
         structured events — breaker flips, chaos fires, ladder

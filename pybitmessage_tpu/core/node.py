@@ -265,6 +265,20 @@ class Node:
             FLIGHT_RECORDER.node_id = self.node_id
             FLIGHT_RECORDER.skew_provider = self.mean_skew
 
+    def set_streams(self, streams) -> None:
+        """Adopt a new shard map mid-session (live split/merge,
+        docs/roles.md): swap ``ctx.streams`` and re-scope the sync
+        digest to the new set.  Re-attaching re-seeds the digest from
+        the inventory index, so an acquired stream's already-stored
+        objects enter the announce view and a shed stream's leave it
+        (the store keeps serving them until TTL — forwarding mode and
+        getdata still need the payloads)."""
+        self.ctx.streams = tuple(sorted(set(streams)))
+        if self.sync_digest is not None:
+            self.sync_digest.streams = set(self.ctx.streams)
+            if hasattr(self.inventory, "attach_digest"):
+                self.inventory.attach_digest(self.sync_digest)
+
     def mean_skew(self) -> float:
         """This node's clock-offset estimate vs its peers: the mean of
         the per-connection wire-trace skew estimators (0.0 without

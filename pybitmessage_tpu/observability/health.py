@@ -227,14 +227,29 @@ class HealthMonitor:
         if runtime is not None:
             snap = runtime.snapshot()
             links = snap.get("links")
-            if links is not None:      # edge: link/breaker state
-                ipc_ok = all(lk["connected"] and not lk["breakerOpen"]
-                             for lk in links)
+            if links is not None:      # edge: replica-set coverage
+                # a down link is NOT degraded by itself — its replica
+                # siblings absorb the traffic (roles/replica.py); the
+                # edge is degraded when some stream has NO member
+                # above the "down" rung left
+                rsets = snap.get("replicaSets", {})
+                uncovered = [s for s, members in rsets.items()
+                             if not any(m["health"] > 0
+                                        for m in members)]
+                ipc_ok = not uncovered if rsets else \
+                    all(lk["connected"] and not lk["breakerOpen"]
+                        for lk in links)
                 ipc_detail = {"links": len(links),
                               "outbox": sum(lk["outbox"] + lk["unacked"]
-                                            for lk in links)}
+                                            for lk in links),
+                              "uncoveredStreams": uncovered,
+                              "shardEpochs": {lk["relay"]: lk["epoch"]
+                                              for lk in links}}
             else:                      # relay: connected edge count
-                ipc_detail = {"edges": len(snap.get("edges", ()))}
+                ipc_detail = {"edges": len(snap.get("edges", ())),
+                              "shardEpoch": snap.get("epoch", 0),
+                              "forwardingStreams":
+                                  sorted(snap.get("forwarding", ()))}
         out["role"] = _verdict(
             ipc_ok, name=getattr(node, "role", "all"),
             streams=list(getattr(getattr(node, "ctx", None),

@@ -24,6 +24,12 @@ Site catalog (docs/resilience.md keeps the authoritative table):
 ``role.ipc``           a cross-role IPC frame send — the edge->relay
                        object hand-off and the relay's ack/push sends
                        (``roles/edge.py``, ``roles/relay.py``)
+``role.handoff``       a live shard-handoff send — the relay->relay
+                       HELLO/control/drain/forward frames of a
+                       split/merge (``roles/relay.py``)
+``role.replica``       an edge's replica health probe (the PING
+                       prober feeding the health ladder,
+                       ``roles/edge.py``)
 ==================  =====================================================
 
 Arming, one of:
@@ -64,6 +70,8 @@ _DEFAULT_EXC: dict[str, type] = {
     "net.dial": OSError,
     "net.send": ConnectionError,
     "role.ipc": ConnectionError,
+    "role.handoff": ConnectionError,
+    "role.replica": ConnectionError,
 }
 
 

@@ -15,6 +15,16 @@ relay's hash dedupe makes redelivery idempotent.  The outbox high
 watermark back-pressures the pump, which back-pressures the
 watermarked object queue, which pauses connection reads — a relay
 outage stalls sockets, not edge memory.
+
+Relays declaring the same stream form that stream's **replica set**
+(``roles/replica.py``): every accepted record fans to ALL members,
+a periodic PING prober + ack-lag watch rank each member on the
+health ladder, and a member that goes down has its banked records
+shifted to its healthy siblings — failover within one breaker
+cooldown, zero objects lost.  Shard maps are **versioned**: each
+``HELLO_ACK``/``SHARD_UPDATE`` carries the relay's monotonic epoch,
+stale maps are ignored, and a map change re-routes any now-misrouted
+banked records (docs/roles.md "Live split/merge").
 """
 
 from __future__ import annotations
@@ -29,6 +39,9 @@ from ..observability.metrics import peer_bucket_label
 from ..resilience import CircuitBreaker, inject
 from ..resilience.policy import ERRORS
 from . import ipc
+from .replica import (ACK_LAG_DEGRADED, FAILOVERS, HEALTH_DEGRADED,
+                      HEALTH_DOWN, HEALTH_OK, RTT_DEGRADED,
+                      build_replica_sets)
 from .streams import shard_owner
 
 logger = logging.getLogger("pybitmessage_tpu.roles")
@@ -51,6 +64,10 @@ FETCHES = REGISTRY.counter(
     "role_edge_fetch_total",
     "Relay payload fetches for getdata service, by outcome",
     ("result",))
+STALE_MAPS = REGISTRY.counter(
+    "role_edge_stale_map_total",
+    "HELLO_ACK/SHARD_UPDATE frames ignored for carrying an older "
+    "shard-map epoch than the link already holds")
 
 #: outbox high watermark (queued + un-acked objects) pausing the pump
 OUTBOX_HIGH = 4096
@@ -59,6 +76,8 @@ BATCH_MAX = 256
 #: reconnect backoff bounds, seconds
 RECONNECT_MIN = 0.2
 RECONNECT_MAX = 5.0
+#: replica health prober cadence, seconds (PING RTT + gauge refresh)
+PING_INTERVAL = 2.0
 
 
 class EdgeCache:
@@ -176,11 +195,19 @@ class EdgeLink:
         #: relay identity learned from HELLO_ACK
         self.relay_id = ""
         self.relay_streams: tuple[int, ...] = ()
+        #: relay shard-map epoch (HELLO_ACK / SHARD_UPDATE; monotonic
+        #: per relay — older maps are ignored as stale)
+        self.epoch = 0
         self.connected = False
+        #: PING round-trip EWMA, seconds (None until the first PONG)
+        self.rtt: float | None = None
+        self._ping_sent_at = 0.0
         #: encoded record blobs awaiting a frame slot
         self.outbox: deque[bytes] = deque()
         #: seq -> list of encoded records awaiting OBJECTS_ACK
         self.unacked: "OrderedDict[int, list[bytes]]" = OrderedDict()
+        #: seq -> send time, feeding the ack-lag health rung
+        self._unacked_at: dict[int, float] = {}
         #: control frames (FETCH/PING) jump the object queue
         self.control: deque[bytes] = deque()
         self.seq = 0
@@ -213,6 +240,37 @@ class EdgeLink:
     def send_control(self, frame: bytes) -> None:
         self.control.append(frame)
         self._wakeup.set()
+
+    # -- health ladder (roles/replica.py) ------------------------------------
+
+    def health(self) -> int:
+        """2 ok / 1 degraded / 0 down — breaker state + PING RTT +
+        ack lag, worst rung wins."""
+        if not self.connected or not self.breaker.available():
+            return HEALTH_DOWN
+        if self.ack_lag() > ACK_LAG_DEGRADED or \
+                (self.rtt is not None and self.rtt > RTT_DEGRADED):
+            return HEALTH_DEGRADED
+        return HEALTH_OK
+
+    def ack_lag(self) -> float:
+        """Age of the oldest un-acked OBJECTS frame, seconds."""
+        if not self._unacked_at:
+            return 0.0
+        return max(0.0,
+                   time.monotonic() - min(self._unacked_at.values()))
+
+    def ping(self) -> None:
+        """Queue one liveness probe (the prober loop's RTT sample)."""
+        self._ping_sent_at = time.monotonic()
+        self.send_control(ipc.pack_frame(ipc.MSG_PING, b""))
+
+    def _note_pong(self) -> None:
+        if not self._ping_sent_at:
+            return
+        sample = time.monotonic() - self._ping_sent_at
+        self.rtt = sample if self.rtt is None else \
+            0.7 * self.rtt + 0.3 * sample
 
     def start(self) -> None:
         self._task = asyncio.create_task(self._run())
@@ -293,10 +351,25 @@ class EdgeLink:
             ipc.read_frame(reader), 10.0)
         if msg_type != ipc.MSG_HELLO_ACK:
             raise ipc.IPCError("expected HELLO_ACK, got %d" % msg_type)
-        role, self.relay_id, self.relay_streams = ipc.decode_hello(payload)
-        logger.info("edge link %s: relay %s owns streams %s",
+        role, self.relay_id, streams, epoch = ipc.decode_hello(payload)
+        if epoch < self.epoch:
+            # a delayed ack from an older relay incarnation: keep the
+            # newer map (stale-epoch rule, docs/roles.md)
+            STALE_MAPS.inc()
+            logger.debug("edge link %s: stale HELLO_ACK epoch %d < %d "
+                         "ignored", self.addr, epoch, self.epoch)
+        else:
+            self.epoch = epoch
+            self.apply_shard_map(streams)
+        logger.info("edge link %s: relay %s owns streams %s (epoch %d)",
                     self.addr, self.relay_id[:8],
-                    self.relay_streams or "(all)")
+                    self.relay_streams or "(all)", self.epoch)
+
+    def apply_shard_map(self, streams: tuple[int, ...]) -> None:
+        """Adopt a (newer) shard map and let the runtime rebuild the
+        replica sets + re-route any now-misrouted banked records."""
+        self.relay_streams = tuple(streams)
+        self.runtime.on_shard_change(self)
 
     async def _close_writer(self) -> None:
         writer, self._writer = self._writer, None
@@ -316,15 +389,22 @@ class EdgeLink:
         first) — redelivery is idempotent relay-side, and routing
         again (rather than pinning to this link) means a relay that
         reconnected owning a DIFFERENT shard doesn't reject records a
-        sibling link now owns."""
-        if not self.unacked:
-            return
+        sibling link now owns.  With this link down, the runtime
+        shifts them to healthy replica-set siblings (failover).  The
+        queued-but-unsent outbox goes through the same routing so a
+        dead member strands nothing."""
+        self._unacked_at.clear()
+        pending = list(self.outbox)
+        self.outbox.clear()
         requeued = 0
         for seq in list(self.unacked):
             records = self.unacked.pop(seq)
             self.runtime.reroute(records, fallback=self)
             requeued += len(records)
-        RESENDS.inc(requeued)
+        if requeued:
+            RESENDS.inc(requeued)
+        if pending:
+            self.runtime.reroute(pending, fallback=self)
         self._wakeup.set()
 
     # -- send / receive ------------------------------------------------------
@@ -354,6 +434,7 @@ class EdgeLink:
             self.seq += 1
             seq = self.seq
             self.unacked[seq] = batch
+            self._unacked_at[seq] = time.monotonic()
             try:
                 inject("role.ipc")
                 if not self.breaker.allow():
@@ -382,6 +463,7 @@ class EdgeLink:
                 seq, accepted, duplicate, rejected = \
                     ipc.decode_objects_ack(payload)
                 records = self.unacked.pop(seq, None)
+                self._unacked_at.pop(seq, None)
                 if records is not None:
                     self.acked_objects += accepted
                     self.duplicate_objects += duplicate
@@ -402,7 +484,20 @@ class EdgeLink:
             elif msg_type == ipc.MSG_PING:
                 self.send_control(ipc.pack_frame(ipc.MSG_PONG, b""))
             elif msg_type == ipc.MSG_PONG:
-                pass
+                self._note_pong()
+            elif msg_type == ipc.MSG_SHARD_UPDATE:
+                epoch, streams = ipc.decode_shard_update(payload)
+                if epoch <= self.epoch:
+                    STALE_MAPS.inc()
+                    logger.debug("edge link %s: stale SHARD_UPDATE "
+                                 "epoch %d <= %d ignored", self.addr,
+                                 epoch, self.epoch)
+                else:
+                    logger.info("edge link %s: shard map -> %s "
+                                "(epoch %d)", self.addr,
+                                streams or "(all)", epoch)
+                    self.epoch = epoch
+                    self.apply_shard_map(streams)
             else:
                 logger.debug("edge link %s: unexpected frame type %d",
                              self.addr, msg_type)
@@ -435,12 +530,18 @@ class EdgeRuntime:
         #: re-issue a FETCH this long after an unanswered one; waiters
         #: older than twice this are dropped (the relay lacks it)
         self.fetch_retry = 10.0
+        #: stream -> ReplicaSet, rebuilt on every learned map change
+        self.replica_sets: dict = {}
+        self.ping_interval = PING_INTERVAL
+        self._probe_task: asyncio.Task | None = None
 
     # -- lifecycle -----------------------------------------------------------
 
     async def start(self) -> None:
+        self.on_shard_change(None)
         for link in self.links:
             link.start()
+        self._probe_task = asyncio.create_task(self._probe_loop())
         self.node.ctx.payload_fetcher = self.fetch_for_getdata
 
     async def stop(self) -> None:
@@ -448,6 +549,12 @@ class EdgeRuntime:
         # into the outbox (no headroom wait — shutdown must not
         # deadlock on a dead relay), then flush every link bounded
         from ..models.objects import extract_tag
+        if self._probe_task is not None:
+            self._probe_task.cancel()
+            try:
+                await self._probe_task
+            except asyncio.CancelledError:
+                pass
         queue = self.node.ctx.object_queue
         while True:
             try:
@@ -457,7 +564,7 @@ class EdgeRuntime:
             record = ipc.encode_record(
                 h, header.object_type, header.stream, header.expires,
                 extract_tag(header, payload), bytes(payload))
-            self.link_for(header.stream).enqueue(record)
+            self.fan_out(header.stream, record)
         for link in self.links:
             await link.stop()
 
@@ -471,38 +578,122 @@ class EdgeRuntime:
         else:
             self._outbox_ok.clear()
 
-    def link_for(self, stream: int) -> EdgeLink:
+    def on_shard_change(self, link: EdgeLink | None) -> None:
+        """A link learned a (newer) shard map — rebuild the replica
+        sets and push the link's banked records back through routing,
+        so anything it no longer owns moves to the new owners (the
+        epoch-flip re-route; in-flight un-acked frames are covered by
+        the old owner's forwarding mode, docs/roles.md)."""
+        self.replica_sets = build_replica_sets(
+            self.links, self.node.ctx.streams)
+        if link is not None and link.outbox:
+            pending = list(link.outbox)
+            link.outbox.clear()
+            self.reroute(pending, fallback=link)
+
+    def members_for(self, stream: int) -> list[EdgeLink]:
+        """The stream's replica-set members (all known owners)."""
+        rset = self.replica_sets.get(stream)
+        if rset is not None and rset.members:
+            return rset.members
         link = shard_owner(stream, {lk: lk.relay_streams
                                     for lk in self.links})
-        return link if link is not None else self.links[0]
+        return [link if link is not None else self.links[0]]
+
+    def link_for(self, stream: int) -> EdgeLink:
+        """The healthiest member of the stream's replica set (control
+        traffic: FETCH; fan object records via :meth:`fan_out`)."""
+        rset = self.replica_sets.get(stream)
+        if rset is not None and rset.members:
+            return rset.primary()
+        return self.members_for(stream)[0]
+
+    def fan_out(self, stream: int, record: bytes) -> None:
+        """Enqueue one record on every live member of the stream's
+        replica set — active-active replication (roles/replica.py).
+        Members currently down are skipped (their healthy siblings
+        carry the record) unless the WHOLE set is down, when the
+        record banks on every member's outbox for the reconnect
+        race."""
+        members = self.members_for(stream)
+        live = [m for m in members if m.health() > HEALTH_DOWN]
+        for member in (live or members):
+            member.enqueue(record)
 
     def reroute(self, records, fallback: EdgeLink) -> None:
-        """Re-queue encoded records on whichever link CURRENTLY owns
+        """Re-queue encoded records on whichever links CURRENTLY own
         their stream (links re-learn shards from HELLO_ACK on every
         reconnect — a relay restarted with a different ``rolestreams``
-        must not be re-sent records a sibling now owns)."""
+        must not be re-sent records a sibling now owns).  A record
+        whose ``fallback`` member is down shifts to the healthy
+        siblings (failover; relay dedupe absorbs any overlap); with
+        no healthy owner anywhere it stays banked on ``fallback``."""
+        shifted = 0
         for record in records:
             try:
-                link = self.link_for(ipc.record_stream(record))
+                stream = ipc.record_stream(record)
             except ipc.IPCError:
-                link = fallback
-            link.enqueue(record)
+                fallback.enqueue(record)
+                continue
+            members = self.members_for(stream)
+            if fallback in members and fallback.health() > HEALTH_DOWN:
+                fallback.enqueue(record)
+                continue
+            live = [m for m in members
+                    if m is not fallback and m.health() > HEALTH_DOWN]
+            if live:
+                for member in live:
+                    member.enqueue(record)
+                if fallback in members:
+                    shifted += 1
+            elif members and fallback not in members:
+                # the shard moved wholesale; bank on the new owners
+                for member in members:
+                    member.enqueue(record)
+            else:
+                fallback.enqueue(record)
+        if shifted:
+            FAILOVERS.inc(shifted)
 
     async def handoff(self, h: bytes, header, payload: bytes) -> None:
         """Pump destination for accepted objects (the edge's
-        ``_pump_objects``): route by the object's stream to its
-        shard's relay.  The record is enqueued FIRST, then headroom is
-        awaited — backpressure flows pump -> object queue ->
-        connection reads -> TCP, and a pump task cancelled mid-wait
+        ``_pump_objects``): fan by the object's stream to every live
+        replica of its shard.  The record is enqueued FIRST, then
+        headroom is awaited — backpressure flows pump -> object queue
+        -> connection reads -> TCP, and a pump task cancelled mid-wait
         (shutdown) has already banked the object in the outbox."""
         from ..models.objects import extract_tag
         record = ipc.encode_record(
             h, header.object_type, header.stream, header.expires,
             extract_tag(header, payload), bytes(payload))
-        self.link_for(header.stream).enqueue(record)
+        self.fan_out(header.stream, record)
         HANDOFFS.labels(result="queued").inc()
         self.note_outbox()
         await self._outbox_ok.wait()
+
+    # -- replica health prober ----------------------------------------------
+
+    async def _probe_loop(self) -> None:
+        """Periodic PING per connected link (the RTT rung of the
+        health ladder) + ``role_replica_health`` gauge refresh.
+        Planted with the ``role.replica`` chaos site — an injected
+        probe failure feeds the link's breaker exactly like a real
+        dead peer."""
+        while True:
+            await asyncio.sleep(self.ping_interval)
+            for link in self.links:
+                if not link.connected:
+                    continue
+                try:
+                    inject("role.replica")
+                    link.ping()
+                except (OSError, ConnectionError) as exc:
+                    link.breaker.record_failure()
+                    ERRORS.labels(site="role.replica").inc()
+                    logger.debug("edge link %s probe failed: %r",
+                                 link.addr, exc)
+            for rset in self.replica_sets.values():
+                rset.export_health()
 
     # -- relay -> edge traffic ----------------------------------------------
 
@@ -571,7 +762,12 @@ class EdgeRuntime:
                 "relay": link.addr,
                 "relayId": link.relay_id,
                 "relayStreams": list(link.relay_streams),
+                "epoch": link.epoch,
                 "connected": link.connected,
+                "health": link.health(),
+                "rttMs": round(link.rtt * 1000, 1)
+                if link.rtt is not None else None,
+                "ackLagS": round(link.ack_lag(), 3),
                 "outbox": len(link.outbox),
                 "unacked": sum(len(v) for v in link.unacked.values()),
                 "acked": link.acked_objects,
@@ -579,6 +775,9 @@ class EdgeRuntime:
                 "rejected": link.rejected_objects,
                 "breakerOpen": not link.breaker.available(),
             } for link in self.links],
+            "replicaSets": {
+                str(stream): rset.snapshot()["members"]
+                for stream, rset in sorted(self.replica_sets.items())},
             "fetchWaiters": len(self._fetch_waiters),
         }
 

@@ -42,11 +42,33 @@ Messages:
     Request one payload by hash (a peer getdata for a known-but-
     uncached hash).
 ``PING``/``PONG``
-    Liveness probe exercising the full framing path.
+    Liveness probe exercising the full framing path.  Edges ride the
+    round-trip time into the per-replica health ladder
+    (``roles/replica.py``).
+``SHARD_UPDATE`` (relay -> edge)
+    The relay's shard map changed mid-session (a live split/merge,
+    docs/roles.md): new epoch + the relay's new owned streams.  An
+    edge treats it exactly like a fresh ``HELLO_ACK`` — rebuild the
+    routing table, re-route any now-misrouted outbox records — but
+    only when the epoch is NEWER than the one it last saw from this
+    relay (stale updates from a delayed frame are ignored).
+``HANDOFF`` (relay -> relay)
+    Control frames bracketing a live shard handoff: ``begin`` (the
+    receiver auto-acquires the stream and bumps its epoch), ``end``
+    (drain complete, the sender sheds the stream) and ``ack``.  The
+    records themselves travel as ordinary acked ``OBJECTS`` frames
+    between the control frames — one frame sequence per slab expiry
+    bucket, so an interrupted handoff resumes bucket-granular.
+
+``HELLO``/``HELLO_ACK`` and ``SHARD_UPDATE`` carry a **shard-map
+epoch** (u64, monotonic per relay).  Older binaries omit the trailing
+epoch field; decoders default it to 0, so a rolling restart can mix
+generations.
 
 Every cross-role hop is breaker-supervised and planted with the
 ``role.ipc`` chaos site (edge frame send, relay ack/push send), the
-way ``farm.*`` guards the solver-farm wire.
+way ``farm.*`` guards the solver-farm wire; handoff control/drain
+sends add the ``role.handoff`` site.
 """
 
 from __future__ import annotations
@@ -73,6 +95,13 @@ MSG_OBJECT_PUSH = 6
 MSG_FETCH = 7
 MSG_PING = 8
 MSG_PONG = 9
+MSG_SHARD_UPDATE = 10
+MSG_HANDOFF = 11
+
+#: HANDOFF frame kinds
+HANDOFF_BEGIN = 0
+HANDOFF_END = 1
+HANDOFF_ACK = 2
 
 #: bounded label vocabulary for the frame counter
 FRAME_NAMES = {
@@ -80,6 +109,7 @@ FRAME_NAMES = {
     MSG_OBJECTS: "objects", MSG_OBJECTS_ACK: "objects_ack",
     MSG_INV: "inv", MSG_OBJECT_PUSH: "object_push",
     MSG_FETCH: "fetch", MSG_PING: "ping", MSG_PONG: "pong",
+    MSG_SHARD_UPDATE: "shard_update", MSG_HANDOFF: "handoff",
 }
 
 FRAMES = REGISTRY.counter(
@@ -148,16 +178,20 @@ def _unpack_str(data: bytes, offset: int) -> tuple[bytes, int]:
 
 # -- messages -----------------------------------------------------------------
 
-def encode_hello(role: str, node_id: str,
-                 streams: tuple[int, ...]) -> bytes:
+def encode_hello(role: str, node_id: str, streams: tuple[int, ...],
+                 epoch: int = 0) -> bytes:
     out = _pack_str(role, 16) + _pack_str(node_id, 64)
     out += struct.pack(">H", len(streams))
     for s in streams:
         out += struct.pack(">I", s)
+    out += struct.pack(">Q", epoch)
     return out
 
 
-def decode_hello(data: bytes) -> tuple[str, str, tuple[int, ...]]:
+def decode_hello(data: bytes) -> tuple[str, str, tuple[int, ...], int]:
+    """-> (role, node_id, streams, epoch).  The trailing shard-map
+    epoch is optional on the wire (pre-epoch binaries omit it) and
+    defaults to 0."""
     role, off = _unpack_str(data, 0)
     node_id, off = _unpack_str(data, off)
     try:
@@ -165,8 +199,12 @@ def decode_hello(data: bytes) -> tuple[str, str, tuple[int, ...]]:
         streams = struct.unpack_from(">%dI" % n, data, off + 2)
     except struct.error as exc:
         raise IPCError("truncated hello: %s" % exc)
+    off += 2 + 4 * n
+    epoch = 0
+    if len(data) >= off + 8:
+        (epoch,) = struct.unpack_from(">Q", data, off)
     return (role.decode("utf-8", "replace"),
-            node_id.decode("utf-8", "replace"), tuple(streams))
+            node_id.decode("utf-8", "replace"), tuple(streams), epoch)
 
 
 #: one object record inside OBJECTS / OBJECT_PUSH:
@@ -269,3 +307,42 @@ def decode_fetch(data: bytes) -> bytes:
     if len(data) < 32:
         raise IPCError("truncated fetch frame")
     return bytes(data[:32])
+
+
+def encode_shard_update(epoch: int, streams: tuple[int, ...]) -> bytes:
+    """Relay -> edge: the relay's shard map is now ``streams`` as of
+    ``epoch`` (monotonic per relay)."""
+    out = struct.pack(">QH", epoch, len(streams))
+    for s in streams:
+        out += struct.pack(">I", s)
+    return out
+
+
+def decode_shard_update(data: bytes) -> tuple[int, tuple[int, ...]]:
+    """-> (epoch, streams)."""
+    try:
+        epoch, n = struct.unpack_from(">QH", data, 0)
+        streams = struct.unpack_from(">%dI" % n, data, 10)
+    except struct.error as exc:
+        raise IPCError("truncated shard update: %s" % exc)
+    return epoch, tuple(streams)
+
+
+#: kind(u8) stream(u32) epoch(u64) bucket(i64; -1 = none)
+_HANDOFF = struct.Struct(">BIQq")
+
+
+def encode_handoff(kind: int, stream: int, epoch: int,
+                   bucket: int = -1) -> bytes:
+    """Handoff control frame (``HANDOFF_BEGIN``/``END``/``ACK``).
+    ``bucket`` tags which expiry bucket the surrounding OBJECTS frames
+    belong to (resume granularity); -1 when not bucket-scoped."""
+    return _HANDOFF.pack(kind, stream, epoch, bucket)
+
+
+def decode_handoff(data: bytes) -> tuple[int, int, int, int]:
+    """-> (kind, stream, epoch, bucket)."""
+    try:
+        return _HANDOFF.unpack_from(data, 0)
+    except struct.error as exc:
+        raise IPCError("truncated handoff frame: %s" % exc)

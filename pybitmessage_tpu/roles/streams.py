@@ -48,3 +48,15 @@ def shard_owner(stream: int, shards: dict) -> object | None:
         if not streams:
             catch_all = owner
     return catch_all
+
+
+def shard_members(stream: int, shards: dict) -> list:
+    """ALL owners of ``stream`` — the stream's replica set (several
+    relays declaring the same shard replicate it; docs/roles.md).
+    Explicit owners win; when none declares the stream, every
+    catch-all owner (empty stream set) is the set."""
+    members = [owner for owner, streams in shards.items()
+               if stream in streams]
+    if members:
+        return members
+    return [owner for owner, streams in shards.items() if not streams]

@@ -727,6 +727,23 @@ class SlabStore:
         with self._lock:
             return list(self._index)
 
+    def export_buckets(self, stream: int):
+        """Live-handoff export (docs/roles.md "Live split/merge"):
+        yields ``(bucket, [hashes])`` pairs covering every unexpired
+        record of ``stream``, grouped by expiry bucket — the natural
+        resumable transfer unit (an interrupted drain re-sends whole
+        buckets; the receiver's hash dedupe absorbs the overlap).
+        Hashes snapshot under the lock; the caller reads payloads item
+        by item and skips any record TTL-dropped mid-drain."""
+        now = int(self._clock())
+        with self._lock:
+            buckets: dict[int, list[bytes]] = {}
+            for h, loc in self._index.items():
+                if loc[_STREAM] == stream and loc[_EXPIRES] > now:
+                    buckets.setdefault(loc[_BUCKET], []).append(h)
+        for bucket in sorted(buckets):
+            yield bucket, buckets[bucket]
+
     def attach_digest(self, digest) -> None:
         """Seed the sync digest from the metadata index — no payload
         read, no table scan — then maintain it incrementally exactly

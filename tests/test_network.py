@@ -34,15 +34,6 @@ def _make_node(listen=True, dandelion_enabled=False):
     return ctx, pool
 
 
-def _solved_object(body: bytes, ttl: int = 600) -> bytes:
-    expires = int(time.time()) + ttl
-    obj = serialize_object(expires, 2, 1, 1, body)
-    target = pow_target(len(obj), ttl)
-    nonce, _ = solve(pow_initial_hash(obj[8:]), target,
-                     lanes=1024, chunks_per_call=8)
-    return nonce.to_bytes(8, "big") + obj[8:]
-
-
 async def _wait_for(predicate, timeout=10.0, interval=0.05):
     deadline = time.time() + timeout
     while time.time() < deadline:
@@ -130,12 +121,17 @@ def test_network_group_antisybil():
 # --- two-node integration ---------------------------------------------------
 
 @pytest.mark.asyncio
-async def test_two_nodes_sync_objects():
+async def test_two_nodes_sync_objects(trivial_pow):
     ctx_a, pool_a = _make_node()
     ctx_b, pool_b = _make_node()
+    # this journey's subject is inv/getdata gossip, not PoW: trivial
+    # deterministic difficulty (conftest) — at full difficulty the
+    # test swung 60-125 s on nonce luck
+    trivial_pow.apply(ctx_a)
+    trivial_pow.apply(ctx_b)
 
     # node A owns an object before the nodes ever meet
-    payload = _solved_object(b"pre-existing object body")
+    payload = trivial_pow.solved_object(b"pre-existing object body")
     h_pre = inventory_hash(payload)
     hdr_expires = int.from_bytes(payload[8:16], "big")
     ctx_a.inventory.add(h_pre, 2, 1, payload, hdr_expires)
@@ -154,7 +150,7 @@ async def test_two_nodes_sync_objects():
         assert ctx_b.inventory[h_pre].payload == payload
 
         # now A generates a NEW object; B must receive it via inv gossip
-        payload2 = _solved_object(b"fresh object")
+        payload2 = trivial_pow.solved_object(b"fresh object")
         h2 = inventory_hash(payload2)
         ctx_a.inventory.add(h2, 2, 1, payload2,
                             int.from_bytes(payload2[8:16], "big"))

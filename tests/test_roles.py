@@ -234,7 +234,23 @@ def test_shard_owner():
 
 def test_ipc_codec_roundtrip():
     hello = ipc.encode_hello("edge", "abcd1234", (1, 2, 7))
-    assert ipc.decode_hello(hello) == ("edge", "abcd1234", (1, 2, 7))
+    assert ipc.decode_hello(hello) == ("edge", "abcd1234", (1, 2, 7), 0)
+    hello = ipc.encode_hello("relay", "abcd1234", (3,), epoch=9)
+    assert ipc.decode_hello(hello) == ("relay", "abcd1234", (3,), 9)
+    # pre-epoch binaries omit the trailing epoch field -> defaults 0
+    legacy = hello[:-8]
+    assert ipc.decode_hello(legacy) == ("relay", "abcd1234", (3,), 0)
+
+    upd = ipc.encode_shard_update(7, (1, 4))
+    assert ipc.decode_shard_update(upd) == (7, (1, 4))
+    ho = ipc.encode_handoff(ipc.HANDOFF_BEGIN, 3, 5, bucket=1234)
+    assert ipc.decode_handoff(ho) == (ipc.HANDOFF_BEGIN, 3, 5, 1234)
+    assert ipc.decode_handoff(
+        ipc.encode_handoff(ipc.HANDOFF_ACK, 3, 6))[3] == -1
+    with pytest.raises(ipc.IPCError):
+        ipc.decode_shard_update(upd[:5])
+    with pytest.raises(ipc.IPCError):
+        ipc.decode_handoff(ho[:4])
 
     rec = ipc.encode_record(b"\xaa" * 32, 2, 3, 1234567, b"\xbb" * 32,
                             b"payload bytes")
@@ -583,6 +599,264 @@ async def test_stream_sharded_two_relays():
         await edge.stop()
         await relay_a.stop()
         await relay_b.stop()
+
+
+async def test_replica_failover_and_fetch_survive_primary_kill():
+    """Replica sets (tentpole a): two relays declaring the same stream
+    form its replica set — every record fans to BOTH (active-active),
+    the health ladder marks a killed member down, and its traffic
+    shifts to the sibling with zero objects lost.  A second edge that
+    only knows hashes from INV deltas still serves getdata through the
+    surviving replica."""
+    from pybitmessage_tpu.network.messages import encode_inv
+    from pybitmessage_tpu.utils.hashes import inventory_hash
+
+    payloads = build_msg_objects(12)
+    extra = build_msg_objects(8)
+    relay_a = make_relay(streams=(1,))
+    relay_b = make_relay(streams=(1,))
+    await relay_a.start()
+    await relay_b.start()
+    a_port = relay_a.role_runtime.listen_port
+    b_port = relay_b.role_runtime.listen_port
+    edge1 = make_edge([a_port, b_port])
+    edge2 = make_edge([a_port, b_port])
+    await edge1.start()
+    await edge2.start()
+    c1 = c2 = None
+    try:
+        rt = edge1.role_runtime
+        await wait_for(lambda: all(lk.connected for lk in rt.links)
+                       and all(lk.connected
+                               for lk in edge2.role_runtime.links),
+                       what="edge links")
+        # both links learned the same shard -> one two-member set
+        assert set(rt.replica_sets) == {1}
+        assert len(rt.replica_sets[1].members) == 2
+
+        c1 = await WireClient().connect(edge1.pool.listen_port)
+        await c1.send_objects(payloads)
+        # active-active: EVERY object lands on BOTH replicas
+        await wait_for(lambda: len(relay_a.inventory) == len(payloads)
+                       and len(relay_b.inventory) == len(payloads),
+                       what="replica convergence")
+        hashes = [inventory_hash(p) for p in payloads]
+        await wait_for(lambda: all(h in edge2.inventory for h in hashes),
+                       what="inv deltas reach edge2")
+
+        # kill the primary under load: in-flight + new records shift
+        # to the surviving sibling, zero loss
+        await relay_a.stop()
+        await c1.send_objects(extra)
+        await wait_for(
+            lambda: len(relay_b.inventory) == len(payloads) + len(extra),
+            what="failover absorb")
+        dead = [lk for lk in rt.links if lk.port == a_port][0]
+        await wait_for(lambda: dead.health() == 0,
+                       what="dead member detected")
+        # the health verdict: a down member alone is NOT degraded —
+        # its sibling still covers the stream
+        eh = edge1.health.health_block()
+        assert eh["role"]["status"] == "ok"
+        assert eh["role"]["uncoveredStreams"] == []
+
+        # FETCH waiters survive the kill: edge2's getdata service
+        # routes to the healthiest member (the survivor)
+        dead2 = [lk for lk in edge2.role_runtime.links
+                 if lk.port == a_port][0]
+        await wait_for(lambda: dead2.health() == 0,
+                       what="edge2 sees the dead member")
+        edge2.role_runtime.fetch_retry = 0.5
+        c2 = await WireClient().connect(edge2.pool.listen_port)
+        await c2.send_packet("getdata", encode_inv([hashes[0]]))
+        obj = await c2.expect("object", timeout=15.0)
+        assert bytes(obj) == payloads[0]
+    finally:
+        for c in (c1, c2):
+            if c is not None:
+                await c.close()
+        await edge1.stop()
+        await edge2.stop()
+        await relay_b.stop()
+
+
+async def test_live_shard_handoff_shed_and_forward():
+    """Live split (tentpole b), in-process end to end: relay A sheds
+    stream 2 to relay B over HANDOFF drains — records move, epochs
+    bump, the edge re-learns both maps from SHARD_UPDATE and routes
+    new traffic to B, and a late record that races the flip into A is
+    stored AND forwarded (double-delivered, never dropped)."""
+    from pybitmessage_tpu.utils.hashes import inventory_hash
+
+    s1 = build_msg_objects(4, stream=1)
+    s2 = build_msg_objects(6, stream=2)
+    relay_a = make_relay(streams=(1, 2))
+    relay_b = make_relay(streams=(3,))
+    await relay_a.start()
+    await relay_b.start()
+    edge = make_edge([relay_a.role_runtime.listen_port,
+                      relay_b.role_runtime.listen_port],
+                     streams=(1, 2, 3))
+    await edge.start()
+    client = None
+    try:
+        await wait_for(lambda: all(lk.connected
+                                   for lk in edge.role_runtime.links),
+                       what="edge links")
+        client = await WireClient().connect(edge.pool.listen_port,
+                                            streams=(1, 2))
+        await client.send_objects(s1 + s2)
+        await wait_for(
+            lambda: len(relay_a.inventory) == len(s1) + len(s2),
+            what="pre-split ingest")
+        assert len(relay_b.inventory) == 0
+
+        target = "127.0.0.1:%d" % relay_b.role_runtime.listen_port
+        res = await relay_a.role_runtime.shed_stream(2, target)
+        assert res["objectsDrained"] == len(s2)
+        assert res["epoch"] == relay_a.role_runtime.epoch == 1
+        # ownership flipped on both ends; B bumped for the acquire
+        assert tuple(relay_a.ctx.streams) == (1,)
+        assert 2 in relay_b.ctx.streams
+        assert relay_b.role_runtime.epoch == 1
+        for p in s2:
+            assert inventory_hash(p) in relay_b.inventory
+        # A keeps the shed records (getdata service) but its restricted
+        # digest drops them — the shard's sketches stay pure
+        assert len(relay_a.sync_digest) == len(s1)
+        assert relay_a.sync_digest.hashes_by_stream(2) == []
+
+        # the edge re-learned BOTH maps from the SHARD_UPDATE
+        # broadcasts and now routes stream 2 at relay B
+        link_a, link_b = edge.role_runtime.links
+        await wait_for(lambda: link_a.relay_streams == (1,)
+                       and 2 in link_b.relay_streams,
+                       what="edge shard update")
+        fresh = build_msg_objects(1, stream=2)[0]
+        fh = inventory_hash(fresh)
+        await client.send_objects([fresh])
+        await wait_for(lambda: fh in relay_b.inventory,
+                       what="post-split routing")
+        assert fh not in relay_a.inventory
+
+        # forwarding mode: a late stream-2 record that still lands on
+        # A (raced the flip) is stored locally AND relayed to B
+        late = build_msg_objects(1, stream=2)[0]
+        lh = inventory_hash(late)
+        rec = ipc.decode_record(ipc.encode_record(
+            lh, 2, 2, int.from_bytes(late[8:16], "big"), b"", late))[0]
+        assert relay_a.role_runtime._accept_record(rec, None) == \
+            "forwarded"
+        assert lh in relay_a.inventory
+        await wait_for(lambda: lh in relay_b.inventory,
+                       what="late record forwarded")
+        snap = relay_a.role_runtime.snapshot()
+        assert snap["forwarding"] == {"2": target}
+    finally:
+        if client is not None:
+            await client.close()
+        await edge.stop()
+        await relay_a.stop()
+        await relay_b.stop()
+
+
+async def test_mid_drain_arrival_shadow_forwarded():
+    """Rescale under load: a record accepted WHILE the drain walks the
+    expiry buckets can belong to a bucket the walk already exported —
+    the runtime shadow-forwards it to the acquiring relay the moment
+    it is stored, so a handoff concurrent with live traffic loses
+    nothing."""
+    from pybitmessage_tpu.utils.hashes import inventory_hash
+
+    relay_a = make_relay(streams=(1, 2))
+    relay_b = make_relay(streams=(3,))
+    await relay_a.start()
+    await relay_b.start()
+    rt = relay_a.role_runtime
+    target = "127.0.0.1:%d" % relay_b.role_runtime.listen_port
+    expires = int(time.time()) + 900
+    for i in range(8):
+        relay_a.inventory.add(inventory_hash(b"drain seed %d" % i),
+                              2, 2, b"drain seed %d" % i, expires, b"")
+
+    late = build_msg_objects(1, stream=2)[0]
+    lh = inventory_hash(late)
+    rec = ipc.decode_record(ipc.encode_record(
+        lh, 2, 2, int.from_bytes(late[8:16], "big"), b"", late))[0]
+
+    real_export = rt._export_stream
+
+    def export_with_arrival(stream):
+        for bucket, hashes in real_export(stream):
+            yield bucket, hashes
+            # a record lands mid-walk, into the (identical-expiry)
+            # bucket that was just exported — the walk cannot carry
+            # it, only the shadow-forward can
+            assert rt._accept_record(rec, None) == "accepted"
+            assert rt.snapshot()["draining"] == {"2": target}
+
+    rt._export_stream = export_with_arrival
+    try:
+        res = await rt.shed_stream(2, target)
+        assert res["objectsDrained"] == 8     # the walk never saw it
+        assert lh in relay_a.inventory
+        await wait_for(lambda: lh in relay_b.inventory,
+                       what="shadow-forwarded mid-drain record")
+        assert rt.snapshot()["draining"] == {}
+    finally:
+        await relay_a.stop()
+        await relay_b.stop()
+
+
+async def test_stale_epoch_frames_ignored():
+    """Versioned shard maps: an EdgeLink ignores HELLO_ACK frames
+    older than its epoch and SHARD_UPDATE frames at-or-older — a
+    delayed frame from a previous relay incarnation can never roll the
+    routing table backwards."""
+    from types import SimpleNamespace
+
+    from pybitmessage_tpu.observability import REGISTRY
+    from pybitmessage_tpu.roles.edge import EdgeRuntime
+
+    node = SimpleNamespace(
+        ctx=SimpleNamespace(streams=(1, 2)), node_id="edge0000")
+    rt = EdgeRuntime(node, "127.0.0.1:9")
+    link = rt.links[0]
+    link.epoch = 5
+    link.relay_streams = (1,)
+    rt.on_shard_change(link)
+    before = REGISTRY.sample("role_edge_stale_map_total") or 0
+
+    # equal and older SHARD_UPDATEs are stale; only the newer applies
+    reader = asyncio.StreamReader()
+    for epoch, streams in ((5, (9,)), (4, (8,)), (6, (2,))):
+        reader.feed_data(ipc.pack_frame(
+            ipc.MSG_SHARD_UPDATE, ipc.encode_shard_update(epoch,
+                                                          streams)))
+    reader.feed_eof()
+    with pytest.raises(asyncio.IncompleteReadError):
+        await link._recv_loop(reader)
+    assert link.epoch == 6
+    assert link.relay_streams == (2,)
+
+    # a stale HELLO_ACK (older relay incarnation acking late) keeps
+    # the newer map too
+    class _W:
+        def write(self, data):
+            pass
+
+        async def drain(self):
+            pass
+
+    reader2 = asyncio.StreamReader()
+    reader2.feed_data(ipc.pack_frame(
+        ipc.MSG_HELLO_ACK, ipc.encode_hello("relay", "old-rely",
+                                            (9,), epoch=3)))
+    await link._handshake(reader2, _W())
+    assert link.epoch == 6
+    assert link.relay_streams == (2,)
+    assert (REGISTRY.sample("role_edge_stale_map_total") or 0) == \
+        before + 3
 
 
 async def test_relay_push_and_edge_fetch_serve_getdata():

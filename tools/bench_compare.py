@@ -127,6 +127,19 @@ GUARDS: list[tuple[str, str, float]] = [
     ("configs.role_split.zero_objects_lost", "equal", 0.0),
     ("configs.role_split.split.objects_per_s", "higher", 0.60),
     ("configs.role_split.ratio_vs_fused", "atleast", 0.25),
+    # elastic shard fabric rescale (ISSUE 18): zero loss across the
+    # split-under-load and kill-a-relay phases (hard invariant), the
+    # post-failover accepted rate (wall-clock: generous band), the
+    # live handoff must actually complete (exactly one epoch flip),
+    # and a sanity floor on the post-split step-up — smoke runs every
+    # process on one saturated host, so the honest smoke bar is only
+    # "the rescale did not collapse ingest"; the real step-up
+    # assertion (BMTPU_RESCALE_STEP_FLOOR) lives in bench.py full mode
+    ("configs.role_split.rescale.zero_objects_lost", "equal", 0.0),
+    ("configs.role_split.rescale.failover.objects_per_s",
+     "higher", 0.60),
+    ("configs.role_split.rescale.handoff.epoch", "equal", 1.0),
+    ("configs.role_split.rescale.step_up_ratio", "atleast", 0.25),
     # ingest through the role-split path on a wide keyring (ISSUE 14
     # satellite): delivery-complete rate band + the loss invariant
     ("configs.ingest_storm.wide_host.objects_per_s", "higher", 0.60),
