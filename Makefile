@@ -3,7 +3,7 @@
 # pybitmessage_tpu/pow/native.py when missing or stale.
 
 .PHONY: all native test bench bench-smoke chaos perfguard lint \
-	roles-smoke profile-smoke device-smoke doctor clean
+	roles-smoke clients-smoke profile-smoke device-smoke doctor clean
 
 all: native
 
@@ -91,6 +91,13 @@ doctor:
 roles-smoke: native
 	JAX_PLATFORMS=cpu python -m pytest tests/test_roles_smoke.py \
 		tests/test_roles.py -q
+
+# Light-client tier regression (docs/roles.md): subscription wire
+# codecs, inverted-index bounds/rebucket, DIGEST_DELTA+FETCH repair
+# under churn, chaos reconnect-convergence, farm-delegated PoW tenant
+# attribution and client-side trial decryption.  CI-runnable, no TPU.
+clients-smoke: native
+	JAX_PLATFORMS=cpu python -m pytest tests/test_roles_clients.py -q
 
 clean:
 	$(MAKE) -C native/pow clean

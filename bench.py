@@ -3024,6 +3024,234 @@ def _bench_federated_mesh(smoke: bool = False) -> dict:
     return out
 
 
+def _build_tagged_broadcasts(n: int, tags, *, ntpb: int = 10,
+                             extra: int = 10, ttl: int = 900,
+                             stream: int = 1):
+    """PoW-valid broadcast-v5-shaped objects carrying an address-
+    derived tag from ``tags`` (round-robin) — the predictable-routing
+    flood of the light-client bench.  The edge only reads the header
+    shape (``extract_tag``: type 3 version 5 -> leading 32-byte tag);
+    the body past the tag is junk, PoW is the only build cost."""
+    from pybitmessage_tpu.models.constants import OBJECT_BROADCAST
+    from pybitmessage_tpu.models.objects import serialize_object
+    from pybitmessage_tpu.models.pow_math import pow_target
+    from pybitmessage_tpu.pow.dispatcher import python_solve
+    from pybitmessage_tpu.utils.hashes import sha512 as _sha512
+
+    expires = int(time.time()) + ttl
+    out = []
+    for i in range(n):
+        # tag + ciphertext-shaped junk; check_by_type wants >=180 bytes
+        # total for a broadcast
+        body = (bytes(tags[i % len(tags)]) + os.urandom(160)
+                + i.to_bytes(8, "big"))
+        obj = serialize_object(expires, OBJECT_BROADCAST, 5, stream,
+                               body)
+        target = pow_target(len(obj), ttl, ntpb, extra, clamp=False)
+        nonce, _ = python_solve(_sha512(obj[8:]), target)
+        out.append(nonce.to_bytes(8, "big") + obj[8:])
+    return out
+
+
+def _anonymity_set(tags, counts=(64, 256, 1024)) -> dict:
+    """The privacy knob, measured (ROADMAP item 1; docs/sync.md
+    "Bucket count vs anonymity set"): with this client-tag population,
+    how many clients share a bucket at each bucket count — the
+    anonymity set an observer of SUBSCRIBE frames must break.  More
+    buckets mean less push bandwidth but fewer co-bucketed clients."""
+    from pybitmessage_tpu.sync.digest import bucket_of
+    out = {}
+    for count in counts:
+        hist = [0] * count
+        for t in tags:
+            hist[bucket_of(t, count)] += 1
+        occupied = sorted(h for h in hist if h)
+        out[str(count)] = {
+            "median_clients_per_bucket": float(
+                statistics.median(occupied)) if occupied else 0.0,
+            "min_clients_per_bucket": occupied[0] if occupied else 0,
+            "occupied_buckets": len(occupied),
+        }
+    return out
+
+
+def _bench_light_clients(smoke: bool = False) -> dict:
+    """Light-client tier (ISSUE 19 tentpole; ROADMAP item 1): flood
+    one edge over the real wire path (TCP -> framing -> PoW verify ->
+    role IPC to a relay) while the subscription plane's client count
+    scales 1k -> 100k (smoke-scaled), and measure that accepted obj/s
+    stays FLAT — per-object cost is one inverted-index probe +
+    fan-out to the (fixed, small) matched set, independent of how
+    many clients are connected.  A handful of REAL LightClient
+    sessions subscribe the flood's tags and must converge to every
+    subscribed object (push or DIGEST_DELTA+FETCH repair) — zero
+    subscribed-object loss is asserted at every scale.  The scaling
+    population enters the inverted index exactly as SUBSCRIBE frames
+    would put it there (one membership set per client id), without
+    paying 100k real sockets the bench host cannot hold.
+
+    Asserted bands (perfguard-committed): ``flat_rate_ratio`` >= 0.8
+    (slowest scale vs the smallest), ``subscribed_objects_lost`` ==
+    0, and the ``anonymity_set`` medians monotonically shrinking as
+    the bucket count grows (the privacy knob behaving as documented).
+    Edge crypto CPU share rides the attribution dict: trial-decrypt
+    lives on the clients, so the edge's share must be near zero."""
+    import asyncio
+    import random as _random
+
+    from pybitmessage_tpu.core.node import Node
+    from pybitmessage_tpu.roles.client import (LightClient,
+                                               buckets_for_tags)
+    from pybitmessage_tpu.utils.hashes import inventory_hash
+
+    scales = [100, 1000] if smoke else [1000, 10000, 100000]
+    n_matched = 48 if smoke else 400
+    n_bulk = 16 if smoke else 100
+    n_real = 4 if smoke else 8
+    buckets = 64
+    accept_s = 90.0 if smoke else 420.0
+
+    rng = _random.Random(0x19)
+    flood_tags = [bytes(rng.getrandbits(8) for _ in range(32))
+                  for _ in range(4)]
+    client_tags = [bytes(rng.getrandbits(8) for _ in range(32))
+                   for _ in range(max(scales))]
+
+    t0 = time.perf_counter()
+    payloads = (_build_tagged_broadcasts(n_matched, flood_tags)
+                + _build_relay_objects(n_bulk))
+    build_s = time.perf_counter() - t0
+    matched_hashes = {inventory_hash(p)
+                      for p in payloads[:n_matched]}
+
+    async def run_point(n_clients: int) -> dict:
+        relay = Node(None, port=0, listen=False, test_mode=True,
+                     tls_enabled=False, udp_enabled=False,
+                     role="relay", role_ipc_listen="127.0.0.1:0",
+                     inventory_backend="slab")
+        await relay.start()
+        edge = Node(None, port=0, listen=True, test_mode=True,
+                    tls_enabled=False, udp_enabled=False, role="edge",
+                    role_ipc_connect="127.0.0.1:%d"
+                    % relay.role_runtime.listen_port,
+                    client_listen="127.0.0.1:0",
+                    client_buckets=buckets)
+        await edge.start()
+        plane = edge.client_plane
+        # the scaling population: each simulated client holds exactly
+        # the index state its SUBSCRIBE frame would install — its own
+        # address's buckets, which (being random) rarely match the
+        # flood's tags
+        for i in range(n_clients):
+            plane.index.replace(
+                "sim-%d" % i,
+                [(1, buckets_for_tags([client_tags[i]], buckets))])
+        real = []
+        for i in range(n_real):
+            cli = LightClient(
+                "127.0.0.1:%d" % plane.listen_port,
+                client_id="real-%d" % i, tags=flood_tags,
+                streams=(1,))
+            await cli.start()
+            await cli.wait_synced(15)
+            real.append(cli)
+        wire_client = await _RoleWireClient().connect(
+            edge.pool.listen_port)
+        t1 = time.perf_counter()
+        await wire_client.send_objects(payloads)
+        deadline = time.perf_counter() + accept_s
+        accepted = 0
+        while time.perf_counter() < deadline:
+            accepted = len(edge.inventory)
+            if accepted >= len(payloads):
+                break
+            await asyncio.sleep(0.02)
+        dt = max(time.perf_counter() - t1, 1e-9)
+        # convergence: every real client holds every matched object,
+        # via push or digest repair — the zero-loss bar
+        lost = len(matched_hashes) * len(real)
+        while time.perf_counter() < deadline:
+            lost = sum(len(matched_hashes.difference(c.objects))
+                       for c in real)
+            if lost == 0:
+                break
+            await asyncio.sleep(0.05)
+        snap = plane.snapshot()
+        for c in real:
+            await c.stop()
+        await wire_client.close()
+        await edge.stop()
+        await relay.stop()
+        assert accepted >= len(payloads), (
+            "light_clients@%d accepted %d of %d"
+            % (n_clients, accepted, len(payloads)))
+        return {
+            "clients": n_clients,
+            "objects": len(payloads),
+            "accepted_objects_per_s": round(len(payloads) / dt, 1),
+            "edge_wall_us_per_object": round(dt / len(payloads) * 1e6,
+                                             1),
+            "subscribed_lost": lost,
+            "pushed": snap["pushed"],
+            "overflowed": snap["overflowed"],
+            "index_memberships": snap["index"]["memberships"],
+        }
+
+    points = []
+    for n_clients in scales:
+        with _attributed("light_clients_%d" % n_clients) as att:
+            point = asyncio.run(run_point(n_clients))
+        point["crypto_share"] = att.get("crypto_share", 0.0)
+        point["attribution"] = {
+            "dominant_subsystem": att.get("dominant_subsystem"),
+            "by_subsystem": att.get("by_subsystem", {}),
+        }
+        points.append(point)
+
+    base_rate = points[0]["accepted_objects_per_s"]
+    flat_ratio = round(
+        min(p["accepted_objects_per_s"] for p in points)
+        / max(base_rate, 1e-9), 3)
+    lost_total = sum(p["subscribed_lost"] for p in points)
+    anonymity = _anonymity_set(client_tags)
+    medians = [anonymity[str(c)]["median_clients_per_bucket"]
+               for c in (64, 256, 1024)]
+    monotonic = 1.0 if medians[0] >= medians[1] >= medians[2] else 0.0
+
+    out = {
+        "scales": scales,
+        "flood_objects": len(payloads),
+        "matched_objects": n_matched,
+        "real_clients": n_real,
+        "bucket_count": buckets,
+        "build_s": round(build_s, 2),
+        "points": points,
+        "flat_rate_ratio": flat_ratio,
+        "subscribed_objects_lost": lost_total,
+        "anonymity_set": anonymity,
+        "anonymity_monotonic": monotonic,
+        "edge_crypto_share_max": max(p["crypto_share"]
+                                     for p in points),
+    }
+    # the headline: per-object edge cost independent of client count
+    assert lost_total == 0, (
+        "light_clients lost %d subscribed objects" % lost_total)
+    assert flat_ratio >= 0.8, (
+        "light_clients obj/s NOT flat: ratio %.3f across scales %r "
+        "(rates %r)" % (flat_ratio, scales,
+                        [p["accepted_objects_per_s"] for p in points]))
+    assert monotonic == 1.0, (
+        "anonymity medians not monotonic across bucket counts: %r"
+        % medians)
+    if not smoke:
+        # trial-decrypt lives on the clients: the edge's crypto CPU
+        # share during the flood must be noise, not a keyring sweep
+        assert out["edge_crypto_share_max"] < 0.15, (
+            "edge crypto share %.3f — trial-decrypt leaked back onto "
+            "the edge?" % out["edge_crypto_share_max"])
+    return out
+
+
 def _smoke_main() -> int:
     """Tiny CPU-only bench for CI (``make bench-smoke``): reduced
     slabs, reference test-mode difficulty, XLA impl — exercises the
@@ -3164,6 +3392,15 @@ def _smoke_main() -> int:
         raise
     except Exception as exc:
         configs["role_split"] = {"error": repr(exc)[:200]}
+    # light-client tier (ISSUE 19): flat accepted-obj/s while the
+    # subscription plane's client count scales, zero subscribed-object
+    # loss, anonymity-set sanity — all bands hold in smoke too
+    try:
+        configs["light_clients"] = _bench_light_clients(smoke=True)
+    except AssertionError:
+        raise
+    except Exception as exc:
+        configs["light_clients"] = {"error": repr(exc)[:200]}
     print(json.dumps({
         "metric": "double_sha512_trial_hashes_per_sec_per_chip",
         "value": round(device, 1),
@@ -3189,6 +3426,12 @@ def _smoke_main() -> int:
 
 
 def main():
+    # single-section dispatch: ``bench.py light_clients [--smoke]``
+    # runs just the light-client tier and prints its JSON block
+    if "light_clients" in sys.argv[1:]:
+        print(json.dumps({"light_clients": _bench_light_clients(
+            smoke="--smoke" in sys.argv[1:])}))
+        return 0
     if "--smoke" in sys.argv[1:]:
         return _smoke_main()
     initial_hash = hashlib.sha512(b"pybitmessage-tpu bench").digest()
@@ -3306,6 +3549,16 @@ def main():
         raise
     except Exception as exc:
         configs["role_split"] = {"error": repr(exc)[:200]}
+    # light-client tier (ISSUE 19; ROADMAP item 1): edge obj/s flat
+    # from 1k to 100k connected clients, zero subscribed-object loss,
+    # edge crypto share near zero (trial-decrypt lives on clients) —
+    # asserted inside the bench, must fail loudly
+    try:
+        configs["light_clients"] = _bench_light_clients()
+    except AssertionError:
+        raise
+    except Exception as exc:
+        configs["light_clients"] = {"error": repr(exc)[:200]}
     # measured MFU from a profiler trace (device-side kernel time);
     # the wall-clock u32_ops_per_sec stays alongside for continuity
     mfu_info = None

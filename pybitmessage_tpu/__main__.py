@@ -134,7 +134,10 @@ async def run(args) -> int:
                 farm_listen=settings.get("powfarmlisten") or None,
                 farm_connect=settings.get("powfarmconnect") or None,
                 farm_tenant=settings.get("powfarmtenant"),
-                farm_secret=settings.get("powfarmsecret"))
+                farm_secret=settings.get("powfarmsecret"),
+                client_listen=settings.get("clientplanelisten") or None,
+                client_connect=settings.get("clientconnect") or None,
+                client_buckets=settings.getint("clientbuckets"))
     node.settings = settings
     # edgeprocs > 1: this listener shares its port via SO_REUSEPORT so
     # sibling edge processes can bind alongside (docs/roles.md)

@@ -762,6 +762,12 @@ class CommandHandler:
         runtime = getattr(node, "role_runtime", None)
         if runtime is not None:
             out["ipc"] = runtime.snapshot()
+        plane = getattr(node, "client_plane", None)
+        if plane is not None:
+            out["clientPlane"] = plane.snapshot()
+        light = getattr(node, "light_client", None)
+        if light is not None:
+            out["lightClient"] = light.snapshot()
         return json.dumps(out, indent=4)
 
     def cmd_shardStatus(self):
@@ -1075,6 +1081,22 @@ class CommandHandler:
         return {"programs": progs, "env": st["env"],
                 "dropped": st["dropped"]}
 
+    def _client_tier_stats(self) -> dict:
+        """Light-client tier block for clientStatus (docs/roles.md
+        "client"): the edge-side subscription plane snapshot — which
+        carries ``farmDelegation`` (jobs proxied to the farm under
+        each client's own tenant) — and/or this node's own light-
+        client session when it runs ``role=client``."""
+        plane = getattr(self.node, "client_plane", None)
+        light = getattr(self.node, "light_client", None)
+        out: dict = {"serving": plane is not None,
+                     "lightClient": light is not None}
+        if plane is not None:
+            out["plane"] = plane.snapshot()
+        if light is not None:
+            out["session"] = light.snapshot()
+        return out
+
     def cmd_farmStatus(self):
         """Full PoW solver-farm status: scheduler snapshot (per-lane
         depths, projected waits, per-tenant queued/solved/weights),
@@ -1142,6 +1164,9 @@ class CommandHandler:
             # PoW solver farm: daemon scheduler/tenants + client tier
             # (docs/pow_farm.md)
             "farm": self._farm_stats(),
+            # light-client tier: subscription plane / light-client
+            # session incl. the farm-delegation block (docs/roles.md)
+            "clients": self._client_tier_stats(),
             # device telemetry: per-jitted-program launch/compile
             # attribution + environment fingerprint (docs/
             # observability.md "Device telemetry")

@@ -152,6 +152,17 @@ DEFAULTS: dict[str, str] = {
                                      # list of "host:port" (shard
                                      # ownership learned dynamically
                                      # from HELLO_ACK)
+    "clientplanelisten": "",         # edge: serve the light-client
+                                     # subscription plane on this
+                                     # "port" or "host:port" (empty =
+                                     # no client plane)
+    "clientconnect": "",             # client role: one edge's client
+                                     # plane at "host:port"
+    "clientbuckets": "64",           # filter-digest bucket count the
+                                     # plane serves (privacy knob:
+                                     # more buckets = less bandwidth,
+                                     # smaller anonymity set —
+                                     # docs/sync.md)
     # -- PoW solver farm (docs/pow_farm.md) --
     "powfarmlisten": "",             # serve PoW-as-a-service on this
                                      # "port" or "host:port" (empty =
@@ -354,13 +365,20 @@ VALIDATORS: dict[str, Callable[[str], bool]] = {
     "syncenabled": _validate_bool,
     "syncinterval": _validate_float_range(0.5, 3600.0),
     "syncfanout": _validate_int_range(-1, 1000),
-    "role": lambda v: v in ("all", "edge", "relay"),
+    "role": lambda v: v in ("all", "edge", "relay", "client"),
     "rolestreams": _validate_role_streams,
     "edgeprocs": _validate_int_range(1, 64),
     "roleipclisten": lambda v: v == "" or (
         v.rpartition(":")[2].isdigit()
         and 0 <= int(v.rpartition(":")[2]) <= 65535),
     "roleipcconnect": _validate_endpoint_list,
+    "clientplanelisten": lambda v: v == "" or (
+        v.rpartition(":")[2].isdigit()
+        and 0 <= int(v.rpartition(":")[2]) <= 65535),
+    "clientconnect": lambda v: v == "" or (
+        v.rpartition(":")[2].isdigit()
+        and 1 <= int(v.rpartition(":")[2]) <= 65535),
+    "clientbuckets": _validate_int_range(1, 65535),
     "powfarmlisten": lambda v: v == "" or (
         v.rpartition(":")[2].isdigit()
         and 0 <= int(v.rpartition(":")[2]) <= 65535),

@@ -55,7 +55,10 @@ class Node:
                  role: str = "all",
                  role_streams: tuple[int, ...] | None = None,
                  role_ipc_listen: str | None = None,
-                 role_ipc_connect: str | None = None):
+                 role_ipc_connect: str | None = None,
+                 client_listen: str | None = None,
+                 client_connect: str | None = None,
+                 client_buckets: int = 64):
         #: composable roles (docs/roles.md): ``all`` is the fused
         #: single-process node (default, today's behavior); ``edge``
         #: and ``relay`` split the ingest and authority tiers into
@@ -208,6 +211,37 @@ class Node:
             from ..roles.relay import RelayRuntime
             self.role_runtime = RelayRuntime(self, role_ipc_listen)
 
+        #: light-client tier (docs/roles.md "client"): an edge serving
+        #: filter-digest subscriptions to store-nothing clients, or a
+        #: client node syncing from one edge's plane
+        self.client_plane = None
+        self.light_client = None
+        if client_listen:
+            from ..roles.subscription import ClientPlane
+            self.client_plane = ClientPlane(
+                self, client_listen, buckets=client_buckets)
+        if role == "client":
+            if not client_connect:
+                raise ValueError(
+                    "client role needs clientconnect (host:port of an "
+                    "edge's clientplanelisten)")
+            from ..crypto.batch import BatchCryptoEngine
+            from ..roles.client import LightClient
+            self.client_crypto = BatchCryptoEngine()
+            self.light_client = LightClient(
+                client_connect,
+                client_id=self.ctx.nonce.hex()[:16],
+                tenant=farm_tenant if farm_tenant != "default" else None,
+                streams=streams, buckets=client_buckets,
+                crypto=self.client_crypto)
+
+            def _sync_client_keys() -> None:
+                self.light_client.set_keys(
+                    identities=self.keystore.identities.values(),
+                    subscriptions=self.keystore.active_subscriptions())
+            self.keystore.add_change_listener(_sync_client_keys)
+            _sync_client_keys()
+
         from .uisignal import UISignaler
         self.ui = UISignaler()
         self.sender = SendWorker(
@@ -217,6 +251,8 @@ class Node:
             shutdown=self.shutdown,
             min_ntpb=min_ntpb, min_extra=min_extra,
             ui_signal=self.ui.emit)
+        if self.client_plane is not None:
+            self.sender.on_publish = self.client_plane.on_record
         self.processor = ObjectProcessor(
             keystore=self.keystore, store=self.store,
             inventory=self.inventory, sender=self.sender, pool=self.pool,
@@ -322,6 +358,11 @@ class Node:
             self.federation_publisher.start()
         if self.farm_server is not None:
             await self.farm_server.start()
+        if self.client_plane is not None:
+            await self.client_plane.start()
+        if self.light_client is not None:
+            self.client_crypto.start()
+            await self.light_client.start()
         logger.info("node started (port %s)",
                     self.pool.listen_port if self.listen else "-")
 
@@ -333,6 +374,10 @@ class Node:
         forwards = self.role_spec.forwards_ingest
         while not self.shutdown.is_set():
             h, header, payload = await self.ctx.object_queue.get()
+            if self.client_plane is not None:
+                # one index probe + O(matched clients) fan-out — the
+                # light-client hot path (roles/subscription.py)
+                self.client_plane.on_object(h, header, payload)
             if forwards:
                 await self.role_runtime.handoff(h, header, payload)
             else:
@@ -341,6 +386,11 @@ class Node:
     async def stop(self) -> None:
         """Orderly shutdown (reference shutdown.py:19-91)."""
         self.shutdown.set()
+        if self.light_client is not None:
+            await self.light_client.stop()
+            await self.client_crypto.stop()
+        if self.client_plane is not None:
+            await self.client_plane.stop()
         if self.federation_publisher is not None:
             await self.federation_publisher.stop()
         await self.health.stop()

@@ -287,6 +287,29 @@ class HealthMonitor:
             snap = recon.snapshot_state()
             out["sync"] = _verdict(
                 snap["breakersOpen"] == 0, **snap)
+
+        # light-client tier (docs/roles.md "client"): a plane whose
+        # sessions keep overflowing is deferring pushes into FETCH
+        # repair — functioning, but a sign the outbox watermark or
+        # the client population needs attention; a light client that
+        # cannot hold its edge link is degraded outright
+        plane = getattr(node, "client_plane", None)
+        if plane is not None:
+            snap = plane.snapshot()
+            pushed = max(snap["pushed"], 1)
+            out["clients"] = _verdict(
+                snap["overflowed"] < pushed,
+                sessions=snap["sessions"],
+                subscriptions=snap["index"]["memberships"],
+                epoch=snap["index"]["epoch"],
+                overflowed=snap["overflowed"])
+        light = getattr(node, "light_client", None)
+        if light is not None:
+            snap = light.snapshot()
+            out["lightClient"] = _verdict(
+                snap["connected"], **{k: snap[k] for k in
+                                      ("edge", "connects", "epoch",
+                                       "subscribedBuckets", "objects")})
         return out
 
 

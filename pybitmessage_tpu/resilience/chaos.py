@@ -30,6 +30,9 @@ Site catalog (docs/resilience.md keeps the authoritative table):
 ``role.replica``       an edge's replica health probe (the PING
                        prober feeding the health ladder,
                        ``roles/edge.py``)
+``role.client``        a light-client plane frame send — both the
+                       edge session writer and the client's own sends
+                       (``roles/subscription.py``, ``roles/client.py``)
 ==================  =====================================================
 
 Arming, one of:
@@ -72,6 +75,7 @@ _DEFAULT_EXC: dict[str, type] = {
     "role.ipc": ConnectionError,
     "role.handoff": ConnectionError,
     "role.replica": ConnectionError,
+    "role.client": ConnectionError,
 }
 
 

@@ -15,6 +15,7 @@ __all__ = [
     "ROLES", "RoleSpec", "get_role", "parse_role_streams",
     "shard_owner", "stream_for_address", "stream_for_ripe",
     "EdgeCache", "EdgeRuntime", "RelayRuntime",
+    "ClientPlane", "SubscriptionIndex", "LightClient",
 ]
 
 
@@ -26,4 +27,10 @@ def __getattr__(name):  # PEP 562: runtime classes import lazily so the
     if name == "RelayRuntime":
         from .relay import RelayRuntime
         return RelayRuntime
+    if name in ("ClientPlane", "SubscriptionIndex"):
+        from . import subscription
+        return getattr(subscription, name)
+    if name == "LightClient":
+        from .client import LightClient
+        return LightClient
     raise AttributeError(name)

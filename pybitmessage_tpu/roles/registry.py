@@ -19,6 +19,12 @@ shape behind one API:
             processor + sender and the federation aggregator.  Serves
             the role IPC channel; does not open the shared P2P
             listener (edges own the port).
+``client``  stores and forwards nothing: no inventory, no relay
+            links, no P2P listener, no keyring on any edge.  Syncs
+            filter digests from one edge's subscription plane
+            (``roles/subscription.py``), trial-decrypts locally, and
+            delegates PoW to the farm under its own tenant.  The tier
+            that decouples user count from full-node count.
 ==========  ============================================================
 """
 
@@ -57,6 +63,8 @@ ROLES: dict[str, RoleSpec] = {
                      processes_objects=False, forwards_ingest=True,
                      reuse_port=True),
     "relay": RoleSpec("relay", listens_p2p=False, serves_ipc=True),
+    "client": RoleSpec("client", listens_p2p=False, owns_storage=False,
+                       runs_sync=False, processes_objects=False),
 }
 
 

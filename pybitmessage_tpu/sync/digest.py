@@ -21,6 +21,15 @@ once per node, not per session, so every peer must bucket and mix
 identically.  The per-session salting that protects IBLT rounds from
 collision grinding does not apply here; a ground collision merely
 makes one bucket compare unequal (cost: one bucket's inv list).
+
+The digest doubles as the **light-client filter primitive**
+(docs/sync.md "Digests as client filters"): ``add`` accepts an
+optional *routing key* so the subscription plane can bucket tagged
+objects by their address-derived tag instead of the inventory hash —
+a client can then derive its buckets from its own addresses without
+revealing them.  The key's first two bytes are stored per entry, so
+``resize`` can re-bucket the whole digest in one pass when the
+bucket-count knob changes (clients re-derive and re-subscribe).
 """
 
 from __future__ import annotations
@@ -29,14 +38,17 @@ import threading
 
 from .sketch import short_id
 
-#: buckets per stream; hash -> bucket via its first byte
+#: buckets per stream; hash -> bucket via its first two bytes (the
+#: 16-bit key word supports up to 65536 buckets — the light-client
+#: anonymity knob sweeps 64..1024, and the wire format already allows
+#: MAX_DIGEST_BUCKETS=4096)
 DIGEST_BUCKETS = 64
 #: the session-independent salt digest IDs are mixed with
 DIGEST_SALT = 0
 
 
 def bucket_of(hash_: bytes, buckets: int = DIGEST_BUCKETS) -> int:
-    return hash_[0] % buckets
+    return ((hash_[0] << 8) | hash_[1]) % buckets
 
 
 class InventoryDigest:
@@ -57,8 +69,12 @@ class InventoryDigest:
         self.buckets = buckets
         self.streams = set(streams) if streams is not None else None
         self._lock = threading.RLock()
-        #: hash -> (stream, expires, short_id) — exact removal support
-        self._entries: dict[bytes, tuple[int, int, int]] = {}
+        #: hash -> (stream, expires, short_id, key_word) — exact
+        #: removal support; key_word is the routing key's first two
+        #: bytes (== the hash's unless ``add`` was given an explicit
+        #: key), so the entry's bucket is recomputable under any
+        #: bucket count
+        self._entries: dict[bytes, tuple[int, int, int, int]] = {}
         #: stream -> ([count]*buckets, [xor]*buckets)
         self._streams: dict[int, tuple[list[int], list[int]]] = {}
         #: digests served without an inventory rescan (metrics/tests)
@@ -73,16 +89,23 @@ class InventoryDigest:
 
     # -- incremental maintenance (storage/inventory.py hooks) ----------------
 
-    def add(self, hash_: bytes, stream: int, expires: int) -> None:
+    def add(self, hash_: bytes, stream: int, expires: int,
+            key: bytes | None = None) -> None:
+        """Fold one hash in.  ``key`` (optional) is the routing key the
+        entry buckets under — the subscription plane passes the
+        object's address-derived tag so clients can subscribe by
+        address; ``None`` keeps the historical hash-bucketed behavior
+        (peer sync must bucket identically on both sides)."""
         if self.streams is not None and stream not in self.streams:
             return  # out-of-shard: never folded, never announced
+        kw = bucket_of(key if key else hash_, 1 << 16)
         with self._lock:
             if hash_ in self._entries:
                 return
             sid = short_id(hash_, DIGEST_SALT)
-            self._entries[hash_] = (stream, expires, sid)
+            self._entries[hash_] = (stream, expires, sid, kw)
             counts, xors = self._tables(stream)
-            b = bucket_of(hash_, self.buckets)
+            b = kw % self.buckets
             counts[b] += 1
             xors[b] ^= sid
             self.incremental_updates += 1
@@ -92,9 +115,9 @@ class InventoryDigest:
             entry = self._entries.pop(hash_, None)
             if entry is None:
                 return
-            stream, _, sid = entry
+            stream, _, sid, kw = entry
             counts, xors = self._tables(stream)
-            b = bucket_of(hash_, self.buckets)
+            b = kw % self.buckets
             counts[b] -= 1
             xors[b] ^= sid
             self.incremental_updates += 1
@@ -104,7 +127,7 @@ class InventoryDigest:
         Expired objects must stop being announced even while the SQL
         table still holds them inside its 3 h purge grace."""
         with self._lock:
-            stale = [h for h, (_, exp, _) in self._entries.items()
+            stale = [h for h, (_, exp, _, _) in self._entries.items()
                      if exp <= now]
             for h in stale:
                 self.discard(h)
@@ -112,13 +135,32 @@ class InventoryDigest:
 
     def rebuild(self, seed) -> None:
         """(Re)build from ``(hash, stream, expires)`` triples — the one
-        full scan, paid at attach time only."""
+        full scan, paid at attach time only.  A trailing 4th element
+        per row (the routing key) is honored when present."""
         with self._lock:
             self._entries.clear()
             self._streams.clear()
-            for hash_, stream, expires in seed:
-                self.add(hash_, stream, expires)
+            for row in seed:
+                self.add(*row[:4])
             self.incremental_updates = 0
+
+    def resize(self, buckets: int) -> None:
+        """Re-bucket the whole digest under a new bucket count (the
+        light-client knob change): the stored per-entry key byte makes
+        this a pure table rebuild — no caller rescan.  Peer-sync
+        digests never resize (both sides must bucket identically);
+        only the subscription plane's private digest does."""
+        if buckets < 1:
+            raise ValueError("bucket count must be >= 1")
+        with self._lock:
+            self.buckets = buckets
+            self._streams.clear()
+            for hash_, (stream, _, sid, kw) in self._entries.items():
+                counts, xors = self._tables(stream)
+                b = kw % buckets
+                counts[b] += 1
+                xors[b] ^= sid
+            self.incremental_updates += 1
 
     # -- queries -------------------------------------------------------------
 
@@ -152,10 +194,10 @@ class InventoryDigest:
                           buckets: "set[int] | list[int]") -> list[bytes]:
         wanted = set(buckets)
         with self._lock:
-            return [h for h, (s, _, _) in self._entries.items()
-                    if s == stream and bucket_of(h, self.buckets) in wanted]
+            return [h for h, (s, _, _, kw) in self._entries.items()
+                    if s == stream and kw % self.buckets in wanted]
 
     def hashes_by_stream(self, stream: int) -> list[bytes]:
         with self._lock:
-            return [h for h, (s, _, _) in self._entries.items()
+            return [h for h, (s, _, _, _) in self._entries.items()
                     if s == stream]

@@ -31,10 +31,15 @@ from .viewmodel import (  # noqa: F401
 
 def render_frame(vm: ViewModel, pane: str, selected: int, width: int,
                  message_index: int | None = None,
-                 overlay: list[str] | None = None) -> list[str]:
+                 overlay: list[str] | None = None,
+                 height: int | None = None) -> list[str]:
     """Whole-screen render (header + body) as plain lines — the
     testable composition the curses shell paints.  ``overlay`` (e.g. a
-    QR code) replaces the pane body until dismissed."""
+    QR code) replaces the pane body until dismissed.  With ``height``
+    (the terminal row count) the pane body becomes a viewport that
+    follows the selection — a list taller than the screen (e.g. the
+    Settings pane) scrolls instead of leaving the marker below the
+    fold."""
     tabs = "  ".join(("[%s]" % tr(p)) if p == pane else tr(p)
                      for p in PANES)
     if vm.filter_text:
@@ -45,7 +50,15 @@ def render_frame(vm: ViewModel, pane: str, selected: int, width: int,
     elif message_index is not None:
         out.extend(vm.render_message(message_index, width))
     else:
-        for i, line in enumerate(vm.render_pane(pane, width)):
+        lines = list(vm.render_pane(pane, width))
+        top = 0
+        if height is not None:
+            # 2 header rows above, 1 status row below the body
+            body = max(height - 3, 1)
+            if selected >= body:
+                top = min(selected - body + 1, max(len(lines) - body, 0))
+            lines = lines[top:top + body]
+        for i, line in enumerate(lines, start=top):
             marker = "> " if i == selected else "  "
             out.append(_clip(marker + line, width))
     return out
@@ -94,7 +107,7 @@ def run(rpc: RPCClient) -> int:  # pragma: no cover - needs a tty
             pane = PANES[pane_i]
             frame = render_frame(vm, pane, selected, w,
                                  message_index=message_index,
-                                 overlay=overlay)
+                                 overlay=overlay, height=h)
             for y, line in enumerate(frame[:h - 1]):
                 stdscr.addstr(y, 0, line)
             stdscr.addstr(h - 1, 0, _clip(status_line, w),

@@ -78,6 +78,10 @@ class SendWorker:
                  ui_signal=None):
         #: UISignaler.emit-compatible callback (may be None)
         self.ui_signal = ui_signal or (lambda cmd, data=(): None)
+        #: ``(h, type, stream, expires, tag, payload)`` hook for every
+        #: locally published object — the light-client plane's feed for
+        #: objects that never cross ctx.object_queue (roles/subscription)
+        self.on_publish = None
         self.keystore = keystore
         self.store = store
         self.inventory = inventory
@@ -217,6 +221,8 @@ class SendWorker:
         self.inventory.add(h, object_type, stream, payload, expires, tag)
         if self.pool is not None:
             self.pool.announce_object(h, stream, local=True)
+        if self.on_publish is not None:
+            self.on_publish(h, object_type, stream, expires, tag, payload)
         return h
 
     # -- msg sending ---------------------------------------------------------

@@ -833,7 +833,8 @@ def test_metric_naming_runtime_complement():
 
     root = pathlib.Path(pybitmessage_tpu.__file__).parent
     for sub in ("pow", "network", "storage", "sync", "observability",
-                "workers", "crypto", "utils", "resilience", "api"):
+                "workers", "crypto", "utils", "resilience", "api",
+                "roles", "powfarm"):
         for path in sorted((root / sub).glob("*.py")):
             name = "pybitmessage_tpu.%s" % sub if \
                 path.stem == "__init__" else \

@@ -176,7 +176,7 @@ async def wait_for(predicate, timeout=15.0, what="condition"):
 
 
 def test_role_registry():
-    assert set(ROLES) == {"all", "edge", "relay"}
+    assert set(ROLES) == {"all", "edge", "relay", "client"}
     fused = get_role("all")
     assert fused.owns_storage and fused.runs_sync and fused.listens_p2p
     assert not fused.forwards_ingest and not fused.serves_ipc
@@ -186,6 +186,10 @@ def test_role_registry():
     relay = get_role("relay")
     assert relay.serves_ipc and relay.owns_storage and relay.runs_sync
     assert not relay.listens_p2p
+    client = get_role("client")
+    assert not (client.owns_storage or client.runs_sync
+                or client.listens_p2p or client.serves_ipc
+                or client.forwards_ingest)
     with pytest.raises(ValueError):
         get_role("solver9000")
 

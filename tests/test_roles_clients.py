@@ -1,0 +1,573 @@
+"""Light-client tier (ISSUE 19; docs/roles.md "client" row): the
+subscription wire codecs, inverted-index semantics under churn and
+rebucketing, bucketed-digest reassignment on a bucket-count change,
+DIGEST_DELTA+FETCH repair with concurrent subscribe/unsubscribe churn,
+seeded-chaos reconnect convergence with zero subscribed-object loss,
+farm-delegated PoW with per-client tenant attribution, and client-side
+trial-decrypt through the batch crypto engine."""
+
+import asyncio
+import hashlib
+import os
+import struct
+import time
+from types import SimpleNamespace
+
+import pytest
+
+from pybitmessage_tpu.observability import REGISTRY
+from pybitmessage_tpu.resilience import CHAOS
+from pybitmessage_tpu.roles import ipc
+from pybitmessage_tpu.roles import subscription as wire
+from pybitmessage_tpu.roles.client import LightClient, buckets_for_tags
+from pybitmessage_tpu.roles.registry import ROLES
+from pybitmessage_tpu.roles.subscription import (ClientPlane,
+                                                 SubscriptionIndex)
+from pybitmessage_tpu.sync.digest import InventoryDigest, bucket_of
+
+#: trivial difficulty: ~4 expected trials per solve
+EASY_TARGET = 1 << 62
+
+
+def _h(i: int) -> bytes:
+    return hashlib.sha512(b"client obj %d" % i).digest()[:32]
+
+
+def _record(i: int, tag: bytes = b"", stream: int = 1):
+    """(h, type, stream, expires, tag, payload) for plane.on_record."""
+    payload = os.urandom(40) + i.to_bytes(4, "big")
+    return (_h(i), 42, stream, int(time.time()) + 900, tag, payload)
+
+
+class _StubNode:
+    """The three attributes ClientPlane reads off a Node: the payload
+    cache (FETCH service), the farm tier (delegation) and the local
+    solver ladder (delegation fallback)."""
+
+    def __init__(self):
+        self.inventory: dict = {}
+        self.farm_client = None
+        self.solver = None
+
+    def store(self, rec) -> None:
+        h, type_, stream, expires, tag, payload = rec
+        self.inventory[h] = SimpleNamespace(
+            type=type_, stream=stream, expires=expires, tag=tag,
+            payload=payload)
+
+
+async def _started_plane(buckets: int = 64, **kw):
+    plane = ClientPlane(_StubNode(), "127.0.0.1:0", buckets=buckets)
+    for k, v in kw.items():
+        setattr(plane, k, v)
+    await plane.start()
+    return plane
+
+
+# ---------------------------------------------------------------------------
+# role registry
+# ---------------------------------------------------------------------------
+
+def test_client_role_rung():
+    spec = ROLES["client"]
+    assert not spec.listens_p2p
+    assert not spec.owns_storage
+    assert not spec.runs_sync
+    assert not spec.processes_objects
+
+
+# ---------------------------------------------------------------------------
+# wire codecs
+# ---------------------------------------------------------------------------
+
+def test_subscribe_roundtrip():
+    entries = [(1, (3, 7, 60)), (2, (0,))]
+    data = wire.encode_subscribe("client-a", "tenant-x", 64, entries)
+    cid, tenant, count, back = wire.decode_subscribe(data)
+    assert (cid, tenant, count) == ("client-a", "tenant-x", 64)
+    assert [(s, tuple(bs)) for s, bs in back] == \
+        [(s, tuple(bs)) for s, bs in entries]
+
+
+def test_codec_roundtrips():
+    assert wire.decode_sub_ack(wire.encode_sub_ack(9, 256, 4)) == \
+        (9, 256, 4)
+    assert [(s, tuple(b)) for s, b in wire.decode_unsubscribe(
+        wire.encode_unsubscribe([(1, (5,)), (2, ())]))] == \
+        [(1, (5,)), (2, ())]
+    epoch, count, stream, summaries = wire.decode_digest_delta(
+        wire.encode_digest_delta(7, 64, 1, [(3, 2, 0xdead)]))
+    assert (epoch, count, stream) == (7, 64, 1)
+    assert list(summaries) == [(3, 2, 0xdead)]
+    rec = _record(1, tag=b"\x05" * 32)
+    seq, back = wire.decode_object_push(wire.encode_object_push(
+        11, ipc.encode_record(*rec)))
+    assert seq == 11 and tuple(back) == rec
+    assert wire.decode_object_ack(wire.encode_object_ack(42)) == 42
+    assert wire.decode_fetch(wire.encode_fetch(1, (2, 9))) == (1, (2, 9))
+    ih = hashlib.sha512(b"pow job").digest()
+    assert wire.decode_pow_delegate(wire.encode_pow_delegate(
+        5, ih, EASY_TARGET, 1500)) == (5, ih, EASY_TARGET, 1500)
+    assert wire.decode_pow_result(wire.encode_pow_result(
+        5, wire.POW_OK, 77, 123)) == (5, wire.POW_OK, 77, 123, "")
+    assert wire.decode_pow_result(wire.encode_pow_result(
+        6, wire.POW_ERROR, detail="boom"))[4] == "boom"
+
+
+def test_frame_header_rejects_garbage():
+    msg_type, length = wire.parse_header(
+        wire.pack_frame(wire.MSG_PING, b"x")[:wire.HEADER_LEN])
+    assert (msg_type, length) == (wire.MSG_PING, 1)
+    with pytest.raises(wire.ClientProtocolError):
+        wire.parse_header(b"\x00" * wire.HEADER_LEN)   # bad magic
+    with pytest.raises(wire.ClientProtocolError):
+        wire.pack_frame(wire.MSG_OBJECT_PUSH,
+                        b"\x00" * (wire.MAX_FRAME + 1))
+    bad = struct.pack(">2sBBI", wire.MAGIC, wire.VERSION,
+                      wire.MSG_PING, wire.MAX_FRAME + 1)
+    with pytest.raises(wire.ClientProtocolError):
+        wire.parse_header(bad)                          # oversize
+
+
+def test_routing_key_prefers_tag():
+    h = _h(0)
+    assert wire.routing_key(b"", h) == h
+    assert wire.routing_key(b"\x01" * 32, h) == b"\x01" * 32
+
+
+# ---------------------------------------------------------------------------
+# inverted index
+# ---------------------------------------------------------------------------
+
+def test_index_replace_is_full_state():
+    idx = SubscriptionIndex(buckets=64)
+    assert idx.replace("a", [(1, (3, 9)), (2, (3,))]) == 3
+    assert idx.clients_for(1, 3) == ("a",)
+    # replace drops memberships absent from the new state
+    assert idx.replace("a", [(1, (9,))]) == 1
+    assert idx.clients_for(1, 3) == ()
+    assert idx.clients_for(2, 3) == ()
+    assert idx.clients_for(1, 9) == ("a",)
+    # out-of-range buckets are dropped, not an error
+    assert idx.replace("a", [(1, (9, 64, 9999))]) == 1
+    # empty state removes the client entirely
+    idx.replace("a", [])
+    assert idx.client_count() == 0
+
+
+def test_index_unsubscribe_and_drop():
+    idx = SubscriptionIndex(buckets=64)
+    idx.replace("a", [(1, (1, 2, 3)), (2, (4,))])
+    idx.unsubscribe("a", [(1, (2,))])
+    assert idx.buckets_of("a") == {1: [1, 3], 2: [4]}
+    # empty bucket list drops the whole stream
+    idx.unsubscribe("a", [(1, ())])
+    assert idx.buckets_of("a") == {2: [4]}
+    idx.drop("a")
+    assert idx.client_count() == 0
+    assert idx.clients_for(2, 4) == ()
+
+
+def test_index_bounds():
+    idx = SubscriptionIndex(buckets=1024, max_clients=2,
+                            max_buckets_per_client=3)
+    assert idx.replace("a", [(1, tuple(range(10)))]) == 3
+    assert idx.replace("b", [(1, (0,))]) == 1
+    # client cap: a third NEW client is refused, existing may update
+    assert idx.replace("c", [(1, (0,))]) == 0
+    assert idx.replace("a", [(1, (5,))]) == 1
+
+
+def test_index_rebucket_clears_and_bumps_epoch():
+    idx = SubscriptionIndex(buckets=64)
+    idx.replace("a", [(1, (3,))])
+    epoch0 = idx.epoch
+    idx.rebucket(256)
+    assert idx.buckets == 256
+    assert idx.epoch > epoch0
+    assert idx.client_count() == 0           # derived ids are stale
+    assert idx.clients_for(1, 3) == ()
+    with pytest.raises(ValueError):
+        idx.rebucket(0)
+
+
+def test_index_subscribers_of_groups_buckets():
+    idx = SubscriptionIndex(buckets=64)
+    idx.replace("a", [(1, (1, 2))])
+    idx.replace("b", [(1, (2, 3))])
+    grouped = idx.subscribers_of(1, (1, 2, 3, 4))
+    assert sorted(grouped["a"]) == [1, 2]
+    assert sorted(grouped["b"]) == [2, 3]
+
+
+# ---------------------------------------------------------------------------
+# bucketed digest: key routing + resize reassignment (satellite 3)
+# ---------------------------------------------------------------------------
+
+def test_digest_resize_reassigns_by_stored_key():
+    d = InventoryDigest(buckets=64)
+    tags = [bytes([i]) + os.urandom(31) for i in range(8)]
+    hashes = []
+    for i, tag in enumerate(tags):
+        h = _h(100 + i)
+        hashes.append(h)
+        d.add(h, 1, int(time.time()) + 900, key=tag)
+    for count in (64, 256, 1024, 64):
+        d.resize(count)
+        assert d.buckets == count
+        # every entry lands in the bucket its ROUTING KEY derives
+        # under the new count — the client-side re-derivation contract
+        for h, tag in zip(hashes, tags):
+            b = bucket_of(tag, count)
+            assert h in set(d.hashes_in_buckets(1, (b,)))
+        total = sum(c for c, _ in d.summaries(1))
+        assert total == len(hashes)
+
+
+def test_buckets_for_tags_tracks_count():
+    tags = [os.urandom(32) for _ in range(6)]
+    for count in (64, 256, 1024):
+        got = buckets_for_tags(tags, count)
+        assert got == tuple(sorted({bucket_of(t, count) for t in tags}))
+        assert all(0 <= b < count for b in got)
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: subscribe, push, fetch, rebucket, churn, chaos
+# ---------------------------------------------------------------------------
+
+@pytest.mark.asyncio
+async def test_set_keys_refilters_live_session():
+    """Keystore changes re-subscribe a LIVE session (the daemon wires
+    KeyStore change listeners to set_keys): a client that connected
+    with no tags adopts a new subscription's tag, the edge index gains
+    the membership, and the refilter's catch-up FETCH delivers an
+    object the plane already held."""
+    plane = await _started_plane(buckets=64, delta_interval=0.02)
+    tag = os.urandom(32)
+    rec = _record(0, tag=tag)
+    plane.node.store(rec)
+    plane.on_record(*rec)       # arrives BEFORE the client cares
+    cli = LightClient("127.0.0.1:%d" % plane.listen_port,
+                      client_id="c-keys", buckets=64)
+    await cli.start()
+    try:
+        await cli.wait_synced(10)
+        assert cli.snapshot()["subscribedBuckets"] == 0
+        cli.set_keys(subscriptions=[SimpleNamespace(tag=tag)])
+        for _ in range(200):
+            if rec[0] in cli.objects:
+                break
+            await asyncio.sleep(0.02)
+        assert rec[0] in cli.objects
+        assert cli.snapshot()["subscribedBuckets"] == 1
+        assert plane.index.snapshot()["memberships"] == 1
+    finally:
+        await cli.stop()
+        await plane.stop()
+
+
+@pytest.mark.asyncio
+async def test_client_bucket_reassignment_on_count_change():
+    """A client arriving with the wrong bucket count re-derives from
+    the SUB_ACK; a live plane rebucket re-derives every connected
+    client — and delivery still converges afterwards (satellite 3)."""
+    plane = await _started_plane(buckets=64, delta_interval=0.02)
+    tag = os.urandom(32)
+    cli = LightClient("127.0.0.1:%d" % plane.listen_port,
+                      client_id="c1", tags=[tag], buckets=32)
+    await cli.start()
+    try:
+        await cli.wait_synced(10)
+        assert cli.bucket_count == 64          # adopted from SUB_ACK
+        assert cli.snapshot()["subscribedBuckets"] == 1
+        rec = _record(0, tag=tag)
+        plane.node.store(rec)
+        plane.on_record(*rec)
+        for _ in range(200):
+            if rec[0] in cli.objects:
+                break
+            await asyncio.sleep(0.02)
+        assert rec[0] in cli.objects
+        # live knob change: memberships clear, clients re-derive
+        plane.rebucket(256)
+        for _ in range(200):
+            if cli.bucket_count == 256 and cli.synced.is_set():
+                break
+            await asyncio.sleep(0.02)
+        assert cli.bucket_count == 256
+        assert plane.index.buckets == 256
+        rec2 = _record(1, tag=tag)
+        plane.node.store(rec2)
+        plane.on_record(*rec2)
+        for _ in range(200):
+            if rec2[0] in cli.objects:
+                break
+            await asyncio.sleep(0.02)
+        assert rec2[0] in cli.objects
+        assert REGISTRY.sample("light_client_rebuckets_total") >= 2
+    finally:
+        await cli.stop()
+        await plane.stop()
+
+
+@pytest.mark.asyncio
+async def test_delta_repair_under_subscribe_churn():
+    """Pushes suppressed entirely (outbox watermark 0 = permanent
+    backpressure) while OTHER clients churn subscribe/unsubscribe:
+    every subscribed object still arrives via DIGEST_DELTA compare +
+    FETCH — the repair path IS the delivery guarantee (satellite 3)."""
+    plane = await _started_plane(buckets=64, delta_interval=0.01,
+                                 outbox_high=0)
+    tag = os.urandom(32)
+    cli = LightClient("127.0.0.1:%d" % plane.listen_port,
+                      client_id="keeper", tags=[tag])
+    await cli.start()
+    try:
+        await cli.wait_synced(10)
+
+        stop = asyncio.Event()
+
+        async def churn():
+            i = 0
+            while not stop.is_set():
+                name = "churn-%d" % (i % 7)
+                plane.index.replace(
+                    name, [(1, (i % 64, (i * 13) % 64))])
+                if i % 3 == 2:
+                    plane.index.drop(name)
+                i += 1
+                await asyncio.sleep(0)
+
+        churner = asyncio.create_task(churn())
+        records = [_record(i, tag=tag) for i in range(30)]
+        for rec in records:
+            plane.node.store(rec)
+            plane.on_record(*rec)
+            await asyncio.sleep(0.002)
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            if all(rec[0] in cli.objects for rec in records):
+                break
+            await asyncio.sleep(0.02)
+        stop.set()
+        churner.cancel()
+        missing = [rec[0] for rec in records
+                   if rec[0] not in cli.objects]
+        assert not missing, "lost %d of %d under churn" % (
+            len(missing), len(records))
+        # every unsolicited push overflowed (watermark 0) — delivery
+        # was entirely DIGEST_DELTA + FETCH repair
+        assert plane.snapshot()["overflowed"] >= len(records)
+        assert cli.fetch_repairs > 0
+    finally:
+        await cli.stop()
+        await plane.stop()
+
+
+@pytest.mark.asyncio
+async def test_chaos_reconnect_convergence_zero_loss():
+    """Seeded chaos kills every role.client frame send for a while —
+    the link drops mid-flood, the client reconnects, re-subscribes,
+    FETCHes — and ends holding every subscribed object."""
+    plane = await _started_plane(buckets=64, delta_interval=0.02)
+    tag = os.urandom(32)
+    cli = LightClient("127.0.0.1:%d" % plane.listen_port,
+                      client_id="chaotic", tags=[tag])
+    await cli.start()
+    try:
+        await cli.wait_synced(10)
+        records = [_record(i, tag=tag) for i in range(20)]
+        for rec in records[:5]:
+            plane.node.store(rec)
+            plane.on_record(*rec)
+        CHAOS.arm("role.client", probability=1.0, count=25)
+        try:
+            for rec in records[5:]:
+                plane.node.store(rec)
+                plane.on_record(*rec)
+                await asyncio.sleep(0.01)
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                if all(rec[0] in cli.objects for rec in records):
+                    break
+                await asyncio.sleep(0.05)
+        finally:
+            CHAOS.disarm("role.client")
+        # chaos exhausted: one more repair window must converge
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            if all(rec[0] in cli.objects for rec in records):
+                break
+            await asyncio.sleep(0.05)
+        missing = [rec[0] for rec in records
+                   if rec[0] not in cli.objects]
+        assert not missing, "lost %d of %d across chaos'd links" % (
+            len(missing), len(records))
+        assert REGISTRY.sample("chaos_injected_total",
+                               {"site": "role.client"}) > 0
+    finally:
+        await cli.stop()
+        await plane.stop()
+
+
+@pytest.mark.asyncio
+async def test_untagged_objects_route_by_hash_bucket():
+    """msgs carry no tag: a client subscribing the hash's bucket via
+    ``extra_buckets`` still gets the push (the msg-coverage slices)."""
+    plane = await _started_plane(buckets=64, delta_interval=0.02)
+    rec = _record(0, tag=b"")
+    bucket = bucket_of(rec[0], 64)
+    cli = LightClient("127.0.0.1:%d" % plane.listen_port,
+                      client_id="slices", extra_buckets=(bucket,))
+    await cli.start()
+    try:
+        await cli.wait_synced(10)
+        plane.node.store(rec)
+        plane.on_record(*rec)
+        for _ in range(200):
+            if rec[0] in cli.objects:
+                break
+            await asyncio.sleep(0.02)
+        assert rec[0] in cli.objects
+    finally:
+        await cli.stop()
+        await plane.stop()
+
+
+# ---------------------------------------------------------------------------
+# farm-delegated PoW with tenant attribution (satellite 4)
+# ---------------------------------------------------------------------------
+
+class _LadderSolver:
+    """Deterministic farm-side ladder stand-in (test_pow_farm idiom)."""
+
+    def solve_batch(self, items, *, should_stop=None, start_nonces=None,
+                    progress=None):
+        from pybitmessage_tpu.pow.dispatcher import python_solve
+        starts = list(start_nonces) if start_nonces else [0] * len(items)
+        out = []
+        for i, (ih, target) in enumerate(items):
+            res = python_solve(ih, target, start_nonce=starts[i],
+                               should_stop=should_stop)
+            if progress is not None:
+                progress(i, res[0] + 1)
+            out.append(res)
+        return out
+
+
+@pytest.mark.asyncio
+async def test_pow_delegation_attributes_each_client_tenant():
+    """Two clients delegate through ONE edge plane: the farm's
+    ``farm_tenant_cpu_seconds_total`` separates their tenants — the
+    edge proxies attribution instead of absorbing it."""
+    from pybitmessage_tpu.observability.profiling import \
+        farm_tenant_costs
+    from pybitmessage_tpu.powfarm import FarmClient, FarmServer
+
+    server = FarmServer(_LadderSolver(), window=0.0)
+    await server.start()
+    plane = await _started_plane(buckets=64)
+    plane.node.farm_client = SimpleNamespace(
+        client=FarmClient("127.0.0.1", server.listen_port,
+                          tenant="edge"))
+    clients = []
+    try:
+        for tenant in ("tenant-alice", "tenant-bob"):
+            cli = LightClient("127.0.0.1:%d" % plane.listen_port,
+                              client_id="pow-%s" % tenant,
+                              tenant=tenant, extra_buckets=(0,))
+            await cli.start()
+            await cli.wait_synced(10)
+            clients.append(cli)
+        for i, cli in enumerate(clients):
+            ih = hashlib.sha512(b"delegated %d" % i).digest()
+            nonce, trials = await cli.delegate_pow(ih, EASY_TARGET,
+                                                   timeout=30)
+            from pybitmessage_tpu.pow.dispatcher import host_trial
+            assert host_trial(nonce, ih) <= EASY_TARGET
+            assert trials >= 1
+        costs = farm_tenant_costs()
+        for tenant in ("tenant-alice", "tenant-bob"):
+            assert tenant in costs, (tenant, sorted(costs))
+            assert costs[tenant]["value"] > 0
+        snap = plane.snapshot()["farmDelegation"]
+        assert snap["ok"] >= 2
+        assert snap["tenants"] == 2
+        assert snap["endpoint"] == "127.0.0.1:%d" % server.listen_port
+    finally:
+        for cli in clients:
+            await cli.stop()
+        await plane.stop()
+        await server.stop()
+
+
+@pytest.mark.asyncio
+async def test_pow_delegation_local_fallback():
+    """No farm configured: the edge solves on its own ladder, still
+    attributed to the (bucketed) client tenant, and the client cannot
+    tell the difference."""
+    from pybitmessage_tpu.pow.dispatcher import host_trial, python_solve
+
+    plane = await _started_plane(buckets=64)
+    plane.node.solver = lambda ih, target: python_solve(ih, target)
+    before = REGISTRY.sample("farm_tenant_cpu_seconds_total")
+    cli = LightClient("127.0.0.1:%d" % plane.listen_port,
+                      client_id="local-pow", tenant="loner",
+                      extra_buckets=(1,))
+    await cli.start()
+    try:
+        await cli.wait_synced(10)
+        ih = hashlib.sha512(b"local fallback").digest()
+        nonce, _ = await cli.delegate_pow(ih, EASY_TARGET, timeout=30)
+        assert host_trial(nonce, ih) <= EASY_TARGET
+        assert REGISTRY.sample("farm_tenant_cpu_seconds_total") >= before
+        assert plane.snapshot()["farmDelegation"]["ok"] >= 1
+    finally:
+        await cli.stop()
+        await plane.stop()
+
+
+# ---------------------------------------------------------------------------
+# client-side trial-decrypt (the crypto the edge no longer does)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.asyncio
+async def test_client_trial_decrypt_broadcast():
+    from pybitmessage_tpu.crypto import encrypt, priv_to_pub
+    from pybitmessage_tpu.crypto.batch import BatchCryptoEngine
+    from pybitmessage_tpu.models.payloads import (
+        double_hash_of_address_data, encode_varint)
+    from pybitmessage_tpu.workers.keystore import KeyStore
+
+    ks = KeyStore()
+    ident = ks.create_random("bcaster")
+    sub = ks.subscribe(ident.address, "watched")
+
+    expires = int(time.time()) + 900
+    dh = double_hash_of_address_data(ident.version, ident.stream,
+                                     ident.ripe)
+    # wire layout: nonce(8) || expires(8) || type(4) || varints || tag
+    shell = (b"\x00" * 8 + struct.pack(">Q", expires)
+             + b"\x00\x00\x00\x03"
+             + encode_varint(5) + encode_varint(ident.stream) + dh[32:])
+    plaintext = b"light-client broadcast body"
+    payload = shell + encrypt(plaintext, priv_to_pub(dh[:32]))
+
+    engine = BatchCryptoEngine(use_native=False, use_tpu=False)
+    engine.start()
+    cli = LightClient("127.0.0.1:1", client_id="dec", crypto=engine,
+                      subscriptions=[sub])
+    try:
+        h = hashlib.sha512(payload).digest()[:32]
+        await cli._trial_decrypt(h, 3, payload)
+        assert len(cli.decrypted) == 1
+        got_h, handle, got_plain = cli.decrypted[0]
+        assert got_h == h and handle is sub
+        assert got_plain == plaintext
+        # an unrelated tag produces no candidates, not a miss-decrypt
+        other = shell[:-32] + os.urandom(32) + payload[len(shell):]
+        await cli._trial_decrypt(_h(9), 3, other)
+        assert len(cli.decrypted) == 1
+    finally:
+        await engine.stop()

@@ -141,20 +141,23 @@ def test_tui_pane_tour_and_actions(daemon):
         assert tui.wait_for(b"pty feed", 15, from_mark=mark), \
             "subscription never listed"
 
-        # Settings pane: edit maxdownloadrate to 777
+        # Settings pane: edit maxdownloadrate to 777.  The sorted
+        # settings list is taller than the pty screen (LINES=40), so
+        # wait for the first row, then walk the selection down —
+        # the pane viewport follows it (render_frame height scrolling)
         tui.keys(b"\t\t\t", settle=1.0)      # -> Settings
-        assert tui.wait_for(b"maxdownloadrate", 15), \
-            "settings pane never painted"
-        # move selection down to some row and back: pane renders rows
-        # sorted; select 'maxdownloadrate' by scanning keys client-side
         from pybitmessage_tpu.cli import RPCClient
         import json as _json
         rpc = RPCClient("127.0.0.1", daemon, API_USER, API_PASS)
         keys = sorted(k for k, v in _json.loads(
             rpc.call("getSettings")).items()
             if not isinstance(v, (list, dict)))
+        assert tui.wait_for(keys[0].encode(), 15), \
+            "settings pane never painted"
         idx = keys.index("maxdownloadrate")
         tui.keys(b"j" * idx, settle=1.0)
+        assert tui.wait_for(b"maxdownloadrate", 15), \
+            "selected setting never scrolled into view"
         mark = tui.mark()
         tui.keys(b"\r")                      # edit prompt
         tui.keys(b"777\r", settle=2.0)

@@ -196,6 +196,16 @@ GUARDS: list[tuple[str, str, float]] = [
     ("configs.sync_storm.federation.overhead_frac", "atmost", 0.02),
     ("configs.sync_storm.federation.propagation_ticks.p99",
      "lower", 1.00),
+    # light-client tier (ISSUE 19): accepted obj/s must stay flat as
+    # the subscription plane's client count scales (the O(matched),
+    # not O(connected) headline — a machine-independent ratio), no
+    # subscribed client may ever lose an object (push or
+    # DIGEST_DELTA+FETCH repair both count), and the bucket-count
+    # anonymity knob must keep behaving as documented (median
+    # clients-per-bucket monotonically shrinking 64 -> 256 -> 1024)
+    ("configs.light_clients.flat_rate_ratio", "atleast", 0.8),
+    ("configs.light_clients.subscribed_objects_lost", "equal", 0.0),
+    ("configs.light_clients.anonymity_monotonic", "equal", 1.0),
 ]
 
 

@@ -33,6 +33,7 @@ from dataclasses import dataclass, field
 CRITICAL_DIRS = frozenset({
     "pow", "network", "sync", "crypto", "storage", "workers",
     "observability", "resilience", "api", "ops", "parallel", "tools",
+    "roles", "powfarm",
 })
 
 _ALLOW_RE = re.compile(r"#\s*bmlint:\s*allow\(([^)]*)\)")
